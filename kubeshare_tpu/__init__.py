@@ -1,0 +1,34 @@
+"""KubeShare-TPU: fractional TPU sharing framework.
+
+A TPU-native re-design of the capabilities of KubeShare 2.0 (reference:
+``sonjoyp/KubeShare``), which fractionally shares NVIDIA GPUs between
+Kubernetes pods via a scheduler plugin, a Prometheus telemetry plane, a
+per-node actuation daemon and a CUDA-intercept isolation runtime ("Gemini").
+
+This framework provides the same capability set for TPUs:
+
+- ``kubeshare_tpu.topology``  — chip discovery (PJRT/JAX + fake backend) and
+  the hierarchical *cell* resource model with ICI-mesh-aware locality
+  (re-design of ``pkg/scheduler/cell.go``, ``config.go``).
+- ``kubeshare_tpu.scheduler`` — the placement engine with the same eight
+  extension points as the reference's kube-scheduler plugin
+  (``pkg/scheduler/scheduler.go:50-56``): queue-sort, pre-filter, filter,
+  score, normalize-score, reserve, unreserve, permit; gang scheduling,
+  guarantee/opportunistic tiers.
+- ``kubeshare_tpu.isolation`` — the fractional-isolation runtime: a native
+  (C++) token scheduler with Gemini's quota/window semantics
+  (``docker/kubeshare-gemini-scheduler/launcher.py:78-80``), a per-pod
+  manager, and a chip-owning execution proxy that stands in for the
+  LD_PRELOAD CUDA hook (a TPU chip is single-tenant per process, so
+  interception becomes proxying).
+- ``kubeshare_tpu.telemetry`` — capacity/requirement exporters (parity with
+  ``pkg/collector``, ``pkg/aggregator``) over a registry bus that removes
+  the reference's 5 s Prometheus staleness (its own TODO, README.md:133).
+- ``kubeshare_tpu.nodeagent`` — per-node actuation: per-chip client config
+  files + process lifecycle (parity with ``pkg/config`` + launcher.py).
+- ``kubeshare_tpu.models`` / ``ops`` / ``parallel`` — the JAX workloads the
+  reference exercises (mnist/cifar10/lstm/resnet/vgg, ``test/**``) plus
+  mesh/sharding utilities for multi-chip gangs.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,76 @@
+"""The ``sharedtpu/`` label and annotation vocabulary.
+
+TPU-native counterpart of the reference's ``sharedgpu/`` domain
+(``pkg/scheduler/constants.go:3-28``). Labels are written by the user on a
+workload; annotations are written back by the scheduler at reserve time.
+"""
+
+DOMAIN = "sharedtpu/"
+
+# --- user-facing labels -----------------------------------------------------
+# Coscheduling pod group (constants.go:6-11).
+POD_GROUP_NAME = DOMAIN + "group_name"
+POD_GROUP_HEADCOUNT = DOMAIN + "group_headcount"
+POD_GROUP_THRESHOLD = DOMAIN + "group_threshold"
+
+# Pod priority: 0 = opportunistic, 1-100 = guarantee (constants.go:13-15,
+# pod.go:175-199). Pods in the same group must share a priority.
+POD_PRIORITY = DOMAIN + "priority"
+
+# Upper limit / guaranteed fraction of chip compute time over the accounting
+# window (constants.go:16-19). Fractions in (0, 1] share a chip; integers > 1
+# request whole chips.
+POD_TPU_LIMIT = DOMAIN + "tpu_limit"
+POD_TPU_REQUEST = DOMAIN + "tpu_request"
+
+# HBM request in bytes (constants.go:20-21).
+POD_TPU_MEMORY = DOMAIN + "tpu_mem"
+
+# Chip model constraint, e.g. "tpu-v4" / "tpu-v5e" (constants.go:22-23).
+POD_TPU_MODEL = DOMAIN + "tpu_model"
+
+# --- scheduler-written annotations (constants.go:25-27) ---------------------
+POD_TPU_CHIP_ID = DOMAIN + "tpu_chip_id"     # ≙ sharedgpu/gpu_uuid
+POD_CELL_ID = DOMAIN + "cell_id"
+POD_MANAGER_PORT = DOMAIN + "tpu_manager_port"
+
+# --- environment contract into the workload container -----------------------
+# ≙ NVIDIA_VISIBLE_DEVICES / LD_PRELOAD / POD_MANAGER_PORT / POD_NAME
+# injection (pod.go:435-457). On TPU the client process must NOT grab the
+# chip (single-tenant per process); it is pointed at its pod manager and the
+# chip stays owned by the proxy.
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_POD_MANAGER_PORT = "KUBESHARE_TPU_POD_MANAGER_PORT"
+ENV_POD_NAME = "KUBESHARE_TPU_POD_NAME"
+ENV_SCHEDULER_IP = "KUBESHARE_TPU_SCHEDULER_IP"
+
+# Library/host paths (pod.go:23-26, cmd/kubeshare-query-ip/main.go:22-34).
+LIBRARY_PATH = "/var/lib/kubeshare-tpu/library"
+SCHEDULER_IP_FILE = LIBRARY_PATH + "/schedulerIP.txt"
+
+# Node actuation directories (pkg/config/config.go:19-22): per-chip client
+# lists consumed by the node launcher daemon via inotify.
+SCHEDULER_DIR = "/var/lib/kubeshare-tpu/scheduler"
+CONFIG_DIR = SCHEDULER_DIR + "/config"
+PORT_DIR = SCHEDULER_DIR + "/podmanagerport"
+LOG_DIR = "/var/log/kubeshare-tpu"
+
+# Node label that opts a node into TPU sharing (≙ SharedGPU=true,
+# pkg/scheduler/node.go:18-26).
+NODE_SHARED_TPU_LABEL = "SharedTPU"
+
+# Pod-manager port pool: 512 ports from 50050 per node
+# (pkg/scheduler/scheduler.go:351, node.go:11-15).
+POD_MANAGER_PORT_START = 50050
+POD_MANAGER_PORT_RANGE = 512
+
+# Gemini-parity token scheduler constants
+# (docker/kubeshare-gemini-scheduler/launcher.py:27-29, 75-80).
+SCHD_PORT_START = 49901
+BASE_QUOTA_MS = 300.0
+MIN_QUOTA_MS = 20.0
+WINDOW_MS = 10000.0
+
+# Name under which the scheduler registers (scheduler.go:35-56's
+# Name = "kubeshare-scheduler").
+SCHEDULER_NAME = "kubeshare-tpu-scheduler"

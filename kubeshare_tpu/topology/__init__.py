@@ -1,0 +1,13 @@
+from .chip import ChipInfo
+from .discovery import discover_chips, FakeTopology
+from .cellconfig import CellTypeSpec, CellSpec, TopologyConfig, load_config, config_from_chips
+from .cell import Cell, CellElement, build_cell_chains, CellConstructor, reserve_resource, reclaim_resource
+from .distance import cell_id_distance, ici_distance
+
+__all__ = [
+    "ChipInfo", "discover_chips", "FakeTopology",
+    "CellTypeSpec", "CellSpec", "TopologyConfig", "load_config", "config_from_chips",
+    "Cell", "CellElement", "build_cell_chains", "CellConstructor",
+    "reserve_resource", "reclaim_resource",
+    "cell_id_distance", "ici_distance",
+]

@@ -1,0 +1,60 @@
+"""Chip inventory model.
+
+TPU counterpart of the reference's NVML device record: the collector exports
+``gpu_capacity{node, uuid, model, memory, index}`` (``pkg/collector/
+collector.go:30-35``, ``gpu.go:26-107``). On TPU we additionally carry the
+ICI mesh coordinates — locality on TPU is mesh distance, not PCIe/NVLink
+hops, and the scheduler's cell model consumes the coordinates directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+def normalize_model(device_kind: str) -> str:
+    """Spaces → dashes, matching the reference's metric-safe model names
+    (``pkg/collector/gpu.go:60``): e.g. ``"TPU v5 lite"`` → ``"TPU-v5-lite"``.
+    """
+    return device_kind.strip().replace(" ", "-")
+
+
+@dataclass(frozen=True)
+class ChipInfo:
+    """One TPU chip as seen by discovery."""
+
+    chip_id: str                 # stable id, ≙ GPU UUID ("TPU-<model>-<host>-<index>")
+    index: int                   # per-host chip index
+    host: str                    # node name owning the chip
+    model: str                   # normalized device kind, e.g. "TPU-v5-lite"
+    memory: int                  # HBM bytes
+    coords: tuple[int, ...] = field(default=())   # ICI mesh coordinates (x, y[, z])
+    core_count: int = 1
+
+    def to_labels(self) -> dict[str, str]:
+        """Flatten to the telemetry label set (collector.go:30-35 parity,
+        plus the coords label that replaces NVLink topology)."""
+        return {
+            "node": self.host,
+            "chip_id": self.chip_id,
+            "model": self.model,
+            "memory": str(self.memory),
+            "index": str(self.index),
+            "coords": ",".join(str(c) for c in self.coords),
+        }
+
+    @staticmethod
+    def from_labels(labels: dict[str, str]) -> "ChipInfo":
+        coords = tuple(int(c) for c in labels["coords"].split(",")) if labels.get("coords") else ()
+        return ChipInfo(
+            chip_id=labels["chip_id"],
+            index=int(labels["index"]),
+            host=labels["node"],
+            model=labels["model"],
+            memory=int(labels["memory"]),
+            coords=coords,
+        )
+
+
+def make_chip_id(model: str, host: str, index: int) -> str:
+    return f"TPU-{model}-{host}-{index}"

@@ -1,0 +1,119 @@
+"""Chip discovery.
+
+The reference discovers devices through NVML (``pkg/collector/gpu.go:26-107``,
+including the MIG sub-device branch). The TPU equivalent enumerates chips
+through the live PJRT client (JAX), which exposes device kind, HBM size and
+ICI mesh coordinates — so, unlike the reference, the full topology is
+discoverable and the hand-written cluster config file becomes an optional
+override (the reference's own TODO at ``pkg/scheduler/config.go:18``).
+
+Two backends:
+
+- ``jax``:  enumerate ``jax.devices()`` on the machine that owns the chips.
+- ``fake``: a synthetic mesh for tests and simulation — the analog of the
+  reference's *missing* fake-NVML (it had none; SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+
+from .chip import ChipInfo, make_chip_id, normalize_model
+
+DEFAULT_FAKE_HBM = 16 * 1024**3
+
+
+@dataclass
+class FakeTopology:
+    """Synthetic TPU fleet: ``hosts`` machines × a ``mesh`` of chips each.
+
+    ``mesh`` is the per-host chip grid (e.g. ``(2, 2)`` for a v4 host's 4
+    chips); global coords place hosts side by side along the first axis.
+    """
+
+    hosts: int = 1
+    mesh: tuple[int, ...] = (2, 2)
+    model: str = "TPU-v4"
+    memory: int = DEFAULT_FAKE_HBM
+    host_prefix: str = "tpu-host"
+
+    def chips(self) -> list[ChipInfo]:
+        chips: list[ChipInfo] = []
+        per_host = 1
+        for d in self.mesh:
+            per_host *= d
+        for h in range(self.hosts):
+            host = f"{self.host_prefix}-{h}"
+            for i in range(per_host):
+                coords = []
+                rem = i
+                for dim in reversed(self.mesh):
+                    coords.append(rem % dim)
+                    rem //= dim
+                coords.reverse()
+                coords[0] += h * self.mesh[0]  # hosts tile along axis 0
+                chips.append(ChipInfo(
+                    chip_id=make_chip_id(self.model, host, i),
+                    index=i,
+                    host=host,
+                    model=self.model,
+                    memory=self.memory,
+                    coords=tuple(coords),
+                ))
+        return chips
+
+
+def _jax_chips(host: str | None = None) -> list[ChipInfo]:
+    import jax
+
+    host = host or os.environ.get("NODE_NAME") or socket.gethostname()
+    chips: list[ChipInfo] = []
+    for d in jax.local_devices():
+        model = normalize_model(d.device_kind)
+        try:
+            memory = int(d.memory_stats()["bytes_limit"])
+        except Exception:
+            memory = DEFAULT_FAKE_HBM
+        coords = tuple(getattr(d, "coords", ()) or ())
+        chips.append(ChipInfo(
+            chip_id=make_chip_id(model, host, d.id),
+            index=d.id,
+            host=host,
+            model=model,
+            memory=memory,
+            coords=coords,
+        ))
+    return chips
+
+
+def discover_chips(backend: str = "auto", host: str | None = None,
+                   fake: FakeTopology | None = None) -> list[ChipInfo]:
+    """Enumerate local chips.
+
+    ``backend``: ``"jax"`` (live PJRT), ``"fake"`` (synthetic), or ``"auto"``
+    (``fake`` iff ``$KUBESHARE_TPU_FAKE_TOPOLOGY`` is set, e.g. ``"2:2x2"``
+    = 2 hosts of a 2×2 mesh).
+    """
+    if backend == "auto":
+        backend = "fake" if os.environ.get("KUBESHARE_TPU_FAKE_TOPOLOGY") else "jax"
+    if backend == "jax":
+        return _jax_chips(host)
+    if backend == "fake":
+        if fake is None:
+            fake = parse_fake_spec(os.environ.get("KUBESHARE_TPU_FAKE_TOPOLOGY", "1:2x2"))
+        return fake.chips()
+    raise ValueError(f"unknown discovery backend: {backend}")
+
+
+def parse_fake_spec(spec: str) -> FakeTopology:
+    """``"<hosts>:<d0>x<d1>[x<d2>][@<model>]"`` → :class:`FakeTopology`."""
+    model = "TPU-v4"
+    if "@" in spec:
+        spec, model = spec.split("@", 1)
+    hosts_str, _, mesh_str = spec.partition(":")
+    if not mesh_str:
+        hosts_str, mesh_str = "1", hosts_str
+    mesh = tuple(int(d) for d in mesh_str.split("x"))
+    return FakeTopology(hosts=int(hosts_str), mesh=mesh, model=model)

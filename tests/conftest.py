@@ -1,0 +1,18 @@
+"""Test harness: force JAX onto 8 virtual CPU devices before first import.
+
+Multi-chip hardware is not available in CI; sharding logic is validated on a
+virtual CPU mesh (the fake-backend story the reference lacked — SURVEY §4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the host env presets axon (real TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's jax config pins jax_platforms=axon,cpu regardless of the env
+# var, so override it through the config API (before any backend init).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

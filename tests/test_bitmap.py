@@ -1,0 +1,41 @@
+import pytest
+
+from kubeshare_tpu.utils.bitmap import Bitmap, RRBitmap
+
+
+def test_mask_unmask():
+    b = Bitmap(64)
+    assert not b.is_masked(5)
+    b.mask(5)
+    assert b.is_masked(5)
+    assert b.count() == 1
+    b.unmask(5)
+    assert not b.is_masked(5)
+    assert b.count() == 0
+
+
+def test_bounds():
+    b = Bitmap(8)
+    with pytest.raises(IndexError):
+        b.mask(8)
+    with pytest.raises(ValueError):
+        Bitmap(0)
+
+
+def test_round_robin_allocation():
+    # Port allocation pattern: sequential grants, freed slots are not
+    # immediately reused (round-robin resumes past the cursor) — rrbitmap.go
+    # semantics used for pod-manager ports (node.go:11-15).
+    rr = RRBitmap(4)
+    assert [rr.find_next_and_set() for _ in range(3)] == [0, 1, 2]
+    rr.unmask(1)
+    assert rr.find_next_and_set() == 3   # cursor is past 1, takes 3 first
+    assert rr.find_next_and_set() == 1   # wraps around to the freed slot
+    assert rr.find_next_and_set() == -1  # full
+
+
+def test_port_zero_reserved_pattern():
+    # addNode masks bit 0 so port 50050 is never granted (node.go:37-40).
+    rr = RRBitmap(512)
+    rr.mask(0)
+    assert rr.find_next_and_set() == 1

@@ -1,0 +1,231 @@
+import pytest
+
+from kubeshare_tpu.topology import (
+    CellConstructor,
+    CellSpec,
+    CellTypeSpec,
+    FakeTopology,
+    TopologyConfig,
+    build_cell_chains,
+    cell_id_distance,
+    config_from_chips,
+    discover_chips,
+    ici_distance,
+    reclaim_resource,
+    reserve_resource,
+)
+from kubeshare_tpu.topology.cell import CELL_FILLED, set_node_status
+from kubeshare_tpu.topology.cellconfig import ConfigError, check_physical_cells, parse_config
+
+
+def heterogeneous_config() -> TopologyConfig:
+    """A TPU analog of the reference's heterogeneous lab cluster
+    (deploy/config/kubeshare-config.yaml): one multi-host slice of v5e
+    hosts plus a single v4 host."""
+    raw = {
+        "cellTypes": {
+            "4-TPU-v5e-HOST": {
+                "childCellType": "TPU-v5e",
+                "childCellNumber": 4,
+                "childCellPriority": 50,
+                "isNodeLevel": True,
+            },
+            "3x4-TPU-v5e-SLICE": {
+                "childCellType": "4-TPU-v5e-HOST",
+                "childCellNumber": 3,
+            },
+            "4-TPU-v4-HOST": {
+                "childCellType": "TPU-v4",
+                "childCellNumber": 4,
+                "childCellPriority": 100,
+                "isNodeLevel": True,
+            },
+        },
+        "cells": [
+            {"cellType": "3x4-TPU-v5e-SLICE",
+             "cellChildren": [{"cellId": "host-a"}, {"cellId": "host-b"}, {"cellId": "host-c"}]},
+            {"cellType": "4-TPU-v4-HOST", "cellId": "host-d"},
+        ],
+    }
+    return parse_config(raw)
+
+
+class TestConfigInference:
+    def test_bfs_id_numbering(self):
+        cfg = heterogeneous_config()
+        slice_spec = cfg.cells[0]
+        assert slice_spec.cell_id == "1"  # unnamed root → 1-based list position
+        hosts = slice_spec.children
+        assert [h.cell_id for h in hosts] == ["1/host-a", "1/host-b", "1/host-c"]
+        # Leaf numbering is per BFS level across parents (config.go:77-120):
+        # 12 chips in one level get 1..12 prefixed by their own parent.
+        chips = [c.cell_id for h in hosts for c in h.children]
+        assert chips[:4] == ["1/host-a/1", "1/host-a/2", "1/host-a/3", "1/host-a/4"]
+        assert chips[4] == "1/host-b/5"
+        assert chips[-1] == "1/host-c/12"
+
+    def test_child_types_filled(self):
+        cfg = heterogeneous_config()
+        assert all(h.cell_type == "4-TPU-v5e-HOST" for h in cfg.cells[0].children)
+        assert all(c.cell_type == "TPU-v5e" for c in cfg.cells[0].children[0].children)
+
+    def test_unknown_cell_type_rejected(self):
+        with pytest.raises(ConfigError, match="unknown cellType"):
+            parse_config({"cellTypes": {}, "cells": [{"cellType": "nope"}]})
+
+    def test_priority_range(self):
+        raw = {
+            "cellTypes": {"H": {"childCellType": "T", "childCellNumber": 1,
+                                "childCellPriority": 101, "isNodeLevel": True}},
+            "cells": [{"cellType": "H", "cellId": "n"}],
+        }
+        with pytest.raises(ConfigError, match="priority"):
+            parse_config(raw)
+
+
+class TestCellChains:
+    def test_elements(self):
+        cfg = heterogeneous_config()
+        elements, chip_priority = build_cell_chains(cfg.cell_types)
+        v5e = elements["TPU-v5e"]
+        assert v5e.level == 1 and v5e.leaf_cell_number == 1
+        host = elements["4-TPU-v5e-HOST"]
+        assert host.level == 2 and host.leaf_cell_number == 4
+        assert host.is_node and not host.is_multi_nodes
+        slc = elements["3x4-TPU-v5e-SLICE"]
+        assert slc.level == 3 and slc.leaf_cell_number == 12
+        assert slc.is_multi_nodes and not slc.is_node
+        assert chip_priority == {"TPU-v5e": 50, "TPU-v4": 100}
+
+    def test_constructor_free_list(self):
+        cfg = heterogeneous_config()
+        elements, _ = build_cell_chains(cfg.cell_types)
+        free_list = CellConstructor(elements, cfg.cells).build()
+        assert set(free_list) == {"TPU-v5e", "TPU-v4"}
+        slice_root = free_list["TPU-v5e"][3][0]
+        assert slice_root.available == 12.0
+        assert slice_root.node == ""          # multi-node cell has no node
+        assert slice_root.children[0].node == "host-a"
+        assert slice_root.children[0].children[0].node == "host-a"
+        v4_root = free_list["TPU-v4"][2][0]
+        assert v4_root.node == "host-d" and v4_root.is_node
+
+    def test_top_cell_must_be_node_level(self):
+        # a bare chip-level cell may not be a top cell (cell.go:239-241)
+        cfg = parse_config({
+            "cellTypes": {"H": {"childCellType": "TPU-v4", "childCellNumber": 2,
+                                "childCellPriority": 1, "isNodeLevel": True}},
+            "cells": [{"cellType": "H", "cellId": "n"}],
+        })
+        elements, _ = build_cell_chains(cfg.cell_types)
+        with pytest.raises(ConfigError, match="node-level"):
+            CellConstructor(elements, [CellSpec(cell_type="TPU-v4", cell_id="c")]).build()
+
+
+class TestBindingAndBooking:
+    def _built(self):
+        cfg = heterogeneous_config()
+        elements, _ = build_cell_chains(cfg.cell_types)
+        free_list = CellConstructor(elements, cfg.cells).build()
+        chips = (FakeTopology(hosts=3, mesh=(2, 2), model="TPU-v5e", host_prefix="host").chips())
+        # rename fake hosts to match config
+        by_node = {}
+        for name, fake_host in zip(["host-a", "host-b", "host-c"], ["host-0", "host-1", "host-2"]):
+            by_node[name] = {"TPU-v5e": [c for c in chips if c.host == fake_host]}
+        leaf_cells = {}
+        for node in ["host-a", "host-b", "host-c"]:
+            set_node_status(free_list, by_node, leaf_cells, node, True)
+        return free_list, leaf_cells
+
+    def test_chip_binding_discovery_order(self):
+        free_list, leaf_cells = self._built()
+        root = free_list["TPU-v5e"][3][0]
+        assert root.state == CELL_FILLED and root.healthy
+        assert len(leaf_cells) == 12
+        leaves = list(root.children[0].leaves())
+        assert all(l.chip_id for l in leaves)
+        assert all(l.coords for l in leaves)
+        # memory propagated to ancestors (node.go:257-285)
+        assert root.full_memory == sum(l.full_memory for l in root.leaves())
+
+    def test_reserve_reclaim_walk(self):
+        free_list, leaf_cells = self._built()
+        root = free_list["TPU-v5e"][3][0]
+        leaf = next(iter(root.leaves()))
+        host = leaf.parent
+        mem = 2 * 1024**3
+        reserve_resource(leaf, 0.5, mem)
+        assert leaf.available == 0.5
+        assert host.available == 3.5 and host.available_whole_cell == 3
+        assert root.available == 11.5
+        assert root.free_memory == root.full_memory - mem
+        reclaim_resource(leaf, 0.5, mem)
+        assert root.available == 12.0 and leaf.available == 1.0
+
+    def test_unhealthy_node_excluded_but_booked(self):
+        free_list, leaf_cells = self._built()
+        root = free_list["TPU-v5e"][3][0]
+        leaf = next(iter(root.children[1].leaves()))
+        reserve_resource(leaf, 0.5, 0)
+        set_node_status(free_list, {}, leaf_cells, "host-b", False)
+        assert not root.children[1].healthy
+        assert root.children[0].healthy  # siblings untouched
+        # booking survives the health flip (node.go keeps resources booked)
+        assert leaf.available == 0.5
+
+
+class TestDistance:
+    def test_numeric_ids(self):
+        assert cell_id_distance("1/3", "1/5") == 2
+        assert cell_id_distance("1/1", "1/1") == 0
+
+    def test_node_name_mismatch_penalty(self):
+        assert cell_id_distance("1/host-a/2", "1/host-b/2") == 100
+        assert cell_id_distance("1/host-a/2", "1/host-a/4") == 2
+
+    def test_unequal_depth(self):
+        # leftover leading numeric segments add their value (score.go:188-196)
+        assert cell_id_distance("2/1", "1") == 2
+        assert cell_id_distance("1", "2/1") == 2
+
+    def test_ici_manhattan(self):
+        assert ici_distance((0, 0), (2, 3)) == 5
+        assert ici_distance((0, 0), (3, 0), mesh_shape=(4, 4)) == 1  # torus wrap
+        assert ici_distance((0, 0), (0, 0)) == 0
+
+    def test_ici_rank_mismatch(self):
+        assert ici_distance((1, 0, 0), (0, 0)) >= 100
+
+
+class TestDiscovery:
+    def test_fake_topology(self):
+        chips = discover_chips("fake", fake=FakeTopology(hosts=2, mesh=(2, 2)))
+        assert len(chips) == 8
+        hosts = {c.host for c in chips}
+        assert hosts == {"tpu-host-0", "tpu-host-1"}
+        coords = {c.coords for c in chips}
+        assert len(coords) == 8  # globally unique
+        assert all(c.memory > 0 for c in chips)
+
+    def test_config_from_chips_multi_host(self):
+        chips = FakeTopology(hosts=2, mesh=(2, 2), model="TPU-v4").chips()
+        cfg = config_from_chips(chips)
+        assert "4-TPU-v4-HOST" in cfg.cell_types
+        slice_types = [t for t in cfg.cell_types if "SLICE" in t]
+        assert len(slice_types) == 1
+        elements, _ = build_cell_chains(cfg.cell_types)
+        free_list = CellConstructor(elements, cfg.cells).build()
+        root = free_list["TPU-v4"][3][0]
+        assert root.available == 8.0
+
+    def test_config_from_chips_single_host(self):
+        chips = FakeTopology(hosts=1, mesh=(2, 2), model="TPU-v5e").chips()
+        cfg = config_from_chips(chips)
+        elements, _ = build_cell_chains(cfg.cell_types)
+        free_list = CellConstructor(elements, cfg.cells).build()
+        assert free_list["TPU-v5e"][2][0].node == "tpu-host-0"
+
+    def test_jax_discovery_cpu(self):
+        chips = discover_chips("jax", host="testhost")
+        assert len(chips) == 8  # conftest forces 8 virtual CPU devices
+        assert all(c.host == "testhost" for c in chips)
