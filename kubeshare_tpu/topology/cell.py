@@ -27,6 +27,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
+from ..utils.logger import get_logger
 from .cellconfig import CellSpec, CellTypeSpec, ConfigError
 from .chip import ChipInfo
 
@@ -221,6 +222,14 @@ def set_node_status(free_list: FreeList, chips_by_node: dict[str, dict[str, list
                     if cell.is_node and cell.node == node_name:
                         if cell.state == CELL_FREE and healthy:
                             _bind_chips(cell, chips_by_node, leaf_cells, node_name)
+                        if cell.state == CELL_FREE:
+                            # Nothing bound (no chips discovered for this
+                            # node): leave health untouched, matching the
+                            # reference's n==0 early return in setCellStatus
+                            # (node.go:127-137) — otherwise a healthy-but-
+                            # chipless sighting would open phantom leaves
+                            # (available=1.0, chip_id="") to the scheduler.
+                            continue
                         _set_subtree_health(cell, healthy)
                         _propagate_health_up(cell)
 
@@ -231,9 +240,15 @@ def _bind_chips(node_cell: Cell, chips_by_node: dict[str, dict[str, list[ChipInf
     if not chips:
         return
     idx = 0
+    unbound = 0
     for leaf in node_cell.leaves():
         if idx >= len(chips):
-            break
+            # Config promises more leaves than discovery delivered: close the
+            # phantom leaves (available=1.0, chip_id="") by booking them out,
+            # keeping the booked/free invariant on every ancestor.
+            reserve_resource(leaf, leaf.leaf_cell_number, 0)
+            unbound += 1
+            continue
         chip = chips[idx]
         leaf.chip_id = chip.chip_id
         leaf.coords = chip.coords
@@ -242,6 +257,11 @@ def _bind_chips(node_cell: Cell, chips_by_node: dict[str, dict[str, list[ChipInf
         idx += 1
         _pass_memory_to_parent(leaf)
         leaf_cells[leaf.chip_id] = leaf
+    if unbound:
+        get_logger("topology").warning(
+            "node %s: config has %d more %s leaves than discovery reported "
+            "(%d chips); unbound leaves zeroed out",
+            node_name, unbound, node_cell.leaf_cell_type, len(chips))
     for cell in node_cell.walk():
         cell.state = CELL_FILLED
     cur = node_cell.parent

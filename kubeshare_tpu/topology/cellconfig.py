@@ -153,22 +153,28 @@ def config_from_chips(chips: list[ChipInfo], slice_name: str = "slice",
     for c in chips:
         models.setdefault(c.model, c.memory)
     ordered = sorted(models, key=lambda m: -models[m])
-    priority = {m: (chip_priority or {}).get(m, 100 - 10 * i) for i, m in enumerate(ordered)}
+    priority = {m: (chip_priority or {}).get(m, max(1, 100 - 10 * i))
+                for i, m in enumerate(ordered)}
 
     cell_types: dict[str, CellTypeSpec] = {}
-    hosts_by_shape: dict[tuple[str, int], list[str]] = {}
+    # Group hosts by (model, chips-per-host, slice identity): hosts are fused
+    # into one multi-host cell only when discovery says they share an ICI
+    # slice — two independent v5e-16 slices stay two cells.
+    hosts_by_shape: dict[tuple[str, int, str], list[str]] = {}
     for host, host_chips in sorted(by_host.items()):
         model = host_chips[0].model
-        hosts_by_shape.setdefault((model, len(host_chips)), []).append(host)
+        slice_id = host_chips[0].slice_id
+        hosts_by_shape.setdefault((model, len(host_chips), slice_id), []).append(host)
 
     cells: list[CellSpec] = []
-    for (model, n), hosts in sorted(hosts_by_shape.items()):
+    for (model, n, slice_id), hosts in sorted(hosts_by_shape.items()):
         node_type = f"{n}-{model}-HOST"
-        cell_types[node_type] = CellTypeSpec(
+        cell_types.setdefault(node_type, CellTypeSpec(
             child_cell_type=model, child_cell_number=n,
-            child_cell_priority=priority[model], is_node_level=True)
+            child_cell_priority=priority[model], is_node_level=True))
         if len(hosts) > 1:
-            slice_type = f"{len(hosts)}x{n}-{model}-{slice_name.upper()}"
+            tag = f"-{slice_id}" if slice_id else ""
+            slice_type = f"{len(hosts)}x{n}-{model}-{slice_name.upper()}{tag}"
             cell_types[slice_type] = CellTypeSpec(
                 child_cell_type=node_type, child_cell_number=len(hosts),
                 child_cell_priority=priority[model], is_node_level=False)

@@ -23,13 +23,15 @@ def normalize_model(device_kind: str) -> str:
 class ChipInfo:
     """One TPU chip as seen by discovery."""
 
-    chip_id: str                 # stable id, ≙ GPU UUID ("TPU-<model>-<host>-<index>")
+    chip_id: str                 # stable id, ≙ GPU UUID ("<model>-<host>-<index>",
+                                 # "TPU-"-prefixed only if model lacks the prefix)
     index: int                   # per-host chip index
     host: str                    # node name owning the chip
     model: str                   # normalized device kind, e.g. "TPU-v5-lite"
     memory: int                  # HBM bytes
     coords: tuple[int, ...] = field(default=())   # ICI mesh coordinates (x, y[, z])
     core_count: int = 1
+    slice_id: str = ""           # identity of the ICI slice the chip belongs to
 
     def to_labels(self) -> dict[str, str]:
         """Flatten to the telemetry label set (collector.go:30-35 parity,
@@ -41,6 +43,7 @@ class ChipInfo:
             "memory": str(self.memory),
             "index": str(self.index),
             "coords": ",".join(str(c) for c in self.coords),
+            "slice_id": self.slice_id,
         }
 
     @staticmethod
@@ -53,8 +56,10 @@ class ChipInfo:
             model=labels["model"],
             memory=int(labels["memory"]),
             coords=coords,
+            slice_id=labels.get("slice_id", ""),
         )
 
 
 def make_chip_id(model: str, host: str, index: int) -> str:
-    return f"TPU-{model}-{host}-{index}"
+    prefix = "" if model.upper().startswith("TPU") else "TPU-"
+    return f"{prefix}{model}-{host}-{index}"

@@ -77,13 +77,21 @@ def _jax_chips(host: str | None = None) -> list[ChipInfo]:
         except Exception:
             memory = DEFAULT_FAKE_HBM
         coords = tuple(getattr(d, "coords", ()) or ())
+        # Per-host index (NVML-index parity): local_hardware_id restarts at 0
+        # on every host, unlike the global d.id.
+        index = getattr(d, "local_hardware_id", None)
+        if index is None:
+            index = d.id
+        slice_index = getattr(d, "slice_index", None)
+        slice_id = "" if slice_index is None else str(slice_index)
         chips.append(ChipInfo(
-            chip_id=make_chip_id(model, host, d.id),
-            index=d.id,
+            chip_id=make_chip_id(model, host, index),
+            index=index,
             host=host,
             model=model,
             memory=memory,
             coords=coords,
+            slice_id=slice_id,
         ))
     return chips
 

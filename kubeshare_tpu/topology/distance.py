@@ -58,10 +58,12 @@ def ici_distance(a: tuple[int, ...], b: tuple[int, ...],
     over their common suffix with a DCN penalty per extra axis.
     """
     if len(a) != len(b):
+        common = min(len(a), len(b))
+        # Torus wraparound still applies to the common trailing axes: the
+        # mesh_shape suffix aligns with the coordinate suffix.
+        suffix_shape = mesh_shape[-common:] if mesh_shape else None
         return DCN_PENALTY * abs(len(a) - len(b)) + ici_distance(
-            a[-min(len(a), len(b)):] if len(a) > len(b) else a,
-            b[-min(len(a), len(b)):] if len(b) > len(a) else b,
-            None)
+            a[-common:], b[-common:], suffix_shape)
     total = 0.0
     for axis, (x, y) in enumerate(zip(a, b)):
         d = abs(x - y)

@@ -42,10 +42,12 @@ class Bitmap:
 
     def is_masked(self, pos: int) -> bool:
         self._check(pos)
-        return bool(self._bits >> pos & 1)
+        with self._lock:
+            return bool(self._bits >> pos & 1)
 
     def count(self) -> int:
-        return self._bits.bit_count()
+        with self._lock:
+            return self._bits.bit_count()
 
 
 class RRBitmap(Bitmap):

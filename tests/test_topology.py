@@ -162,6 +162,33 @@ class TestBindingAndBooking:
         reclaim_resource(leaf, 0.5, mem)
         assert root.available == 12.0 and leaf.available == 1.0
 
+    def test_chipless_healthy_node_stays_unhealthy(self):
+        # A healthy sighting with no discovered chips must NOT open phantom
+        # leaves (setCellStatus n==0 early return, node.go:127-137).
+        cfg = heterogeneous_config()
+        elements, _ = build_cell_chains(cfg.cell_types)
+        free_list = CellConstructor(elements, cfg.cells).build()
+        set_node_status(free_list, {"host-d": {"TPU-v4": []}}, {}, "host-d", True)
+        v4_root = free_list["TPU-v4"][2][0]
+        assert not v4_root.healthy
+        assert v4_root.state != CELL_FILLED
+
+    def test_chip_count_mismatch_zeroes_unbound_leaves(self):
+        # Config promises 4 chips, discovery reports 2: the two unbound
+        # leaves must not stay placeable.
+        cfg = heterogeneous_config()
+        elements, _ = build_cell_chains(cfg.cell_types)
+        free_list = CellConstructor(elements, cfg.cells).build()
+        chips = FakeTopology(hosts=1, mesh=(2,), model="TPU-v4", host_prefix="host").chips()
+        set_node_status(free_list, {"host-d": {"TPU-v4": chips}}, {}, "host-d", True)
+        v4_root = free_list["TPU-v4"][2][0]
+        assert v4_root.healthy and v4_root.state == CELL_FILLED
+        bound = [l for l in v4_root.leaves() if l.chip_id]
+        unbound = [l for l in v4_root.leaves() if not l.chip_id]
+        assert len(bound) == 2 and len(unbound) == 2
+        assert all(l.available == 0.0 for l in unbound)
+        assert v4_root.available == 2.0
+
     def test_unhealthy_node_excluded_but_booked(self):
         free_list, leaf_cells = self._built()
         root = free_list["TPU-v5e"][3][0]
@@ -196,6 +223,10 @@ class TestDistance:
     def test_ici_rank_mismatch(self):
         assert ici_distance((1, 0, 0), (0, 0)) >= 100
 
+    def test_ici_rank_mismatch_keeps_torus_wraparound(self):
+        # mesh_shape suffix stays aligned with the common coordinate suffix
+        assert ici_distance((1, 0, 3), (0, 0), mesh_shape=(2, 4, 4)) == 101
+
 
 class TestDiscovery:
     def test_fake_topology(self):
@@ -224,6 +255,18 @@ class TestDiscovery:
         elements, _ = build_cell_chains(cfg.cell_types)
         free_list = CellConstructor(elements, cfg.cells).build()
         assert free_list["TPU-v5e"][2][0].node == "tpu-host-0"
+
+    def test_config_from_chips_slice_identity(self):
+        # Two independent v5e slices of identical shape must NOT be fused
+        # into one multi-host cell.
+        import dataclasses
+        a = FakeTopology(hosts=2, mesh=(2, 2), model="TPU-v5e", host_prefix="sa").chips()
+        b = FakeTopology(hosts=2, mesh=(2, 2), model="TPU-v5e", host_prefix="sb").chips()
+        chips = [dataclasses.replace(c, slice_id="0") for c in a] + \
+                [dataclasses.replace(c, slice_id="1") for c in b]
+        cfg = config_from_chips(chips)
+        slice_types = [t for t in cfg.cell_types if "SLICE" in t]
+        assert len(slice_types) == 2
 
     def test_jax_discovery_cpu(self):
         chips = discover_chips("jax", host="testhost")
