@@ -1,0 +1,21 @@
+"""Workload model zoo.
+
+One module per reference eval workload (``/root/reference/test/**``):
+``mnist`` (north-star benchmark), ``cifar10``, ``lstm``, ``resnet``,
+``vgg``. Each exposes ``init(key)``, ``loss_fn(params, batch)``,
+``batch_fn(key)`` and a ``python -m kubeshare_tpu.models.<name> --steps N``
+CLI; ``common.run_training`` provides the timed loop with the isolation
+gate hook.
+"""
+
+MODEL_NAMES = ("mnist", "cifar10", "lstm", "resnet", "vgg")
+
+
+def get_model(name: str):
+    """Return the model module for *name* (lazy import keeps jax out of
+    control-plane processes)."""
+    import importlib
+
+    if name not in MODEL_NAMES:
+        raise ValueError(f"unknown model {name!r}; have {MODEL_NAMES}")
+    return importlib.import_module(f".{name}", __package__)

@@ -1,0 +1,54 @@
+"""CIFAR-10 conv workload (≙ the reference's ``riyazhu/cifar10:test``
+eval image, ``test/cifar10/job_g.yaml``): 3-stage conv net on 32×32×3."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (batchnorm_apply, batchnorm_init, conv2d_apply, conv2d_init,
+                   dense_apply, dense_init, max_pool, softmax_cross_entropy)
+from .common import main_cli, synthetic_image_batch
+
+BATCH_SIZE = 128
+CLASSES = 10
+DTYPE = jnp.bfloat16
+STAGES = (64, 128, 256)
+
+
+def init(key) -> dict:
+    keys = jax.random.split(key, len(STAGES) * 2 + 1)
+    params: dict = {}
+    in_ch = 3
+    for i, ch in enumerate(STAGES):
+        params[f"conv{i}a"] = conv2d_init(keys[2 * i], in_ch, ch)
+        params[f"conv{i}b"] = conv2d_init(keys[2 * i + 1], ch, ch)
+        params[f"bn{i}"] = batchnorm_init(ch)
+        in_ch = ch
+    params["fc"] = dense_init(keys[-1], 4 * 4 * STAGES[-1], CLASSES)
+    return params
+
+
+def apply(params: dict, x: jax.Array) -> jax.Array:
+    for i in range(len(STAGES)):
+        x = jax.nn.relu(conv2d_apply(params[f"conv{i}a"], x, dtype=DTYPE))
+        x = jax.nn.relu(conv2d_apply(params[f"conv{i}b"], x, dtype=DTYPE))
+        x = batchnorm_apply(params[f"bn{i}"], x.astype(jnp.float32))
+        x = max_pool(x)
+    x = x.reshape(x.shape[0], -1)
+    return dense_apply(params["fc"], x, dtype=DTYPE)
+
+
+def loss_fn(params: dict, batch) -> jax.Array:
+    x, y = batch
+    return softmax_cross_entropy(apply(params, x), y)
+
+
+batch_fn = partial(synthetic_image_batch, batch_size=BATCH_SIZE, hw=32,
+                   channels=3, classes=CLASSES)
+
+
+if __name__ == "__main__":
+    main_cli("cifar10", init, loss_fn, batch_fn)

@@ -1,0 +1,109 @@
+"""Shared training machinery for the workload models.
+
+The reference's eval workloads are external torch images driven by pod
+manifests (``test/mnist/mnist1.yaml:15`` etc.); here each model module
+exposes a functional ``(init, loss_fn)`` pair and this module turns it into
+a jitted SGD/Adam train step plus a timed loop. The loop takes an optional
+``gate`` callable — the isolation runtime's client-side execution gate
+(≙ the reference's libgemhook token round-trip before each kernel burst)
+plugs in there without the model knowing.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclass
+class TrainResult:
+    steps: int
+    seconds: float
+    final_loss: float
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self.steps / self.seconds if self.seconds > 0 else 0.0
+
+
+def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
+    """``loss_fn(params, batch) -> scalar`` → jitted
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``."""
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def synthetic_image_batch(key, batch_size: int, hw: int, channels: int,
+                          classes: int) -> tuple[jax.Array, jax.Array]:
+    xkey, ykey = jax.random.split(key)
+    x = jax.random.normal(xkey, (batch_size, hw, hw, channels), jnp.float32)
+    y = jax.random.randint(ykey, (batch_size,), 0, classes)
+    return x, y
+
+
+def synthetic_token_batch(key, batch_size: int, seq_len: int,
+                          vocab: int) -> tuple[jax.Array, jax.Array]:
+    tokens = jax.random.randint(key, (batch_size, seq_len + 1), 0, vocab)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
+                 steps: int, learning_rate: float = 1e-3, seed: int = 0,
+                 warmup: int = 2, gate: Callable | None = None,
+                 optimizer: optax.GradientTransformation | None = None) -> TrainResult:
+    """Train for ``steps`` timed steps on one fixed synthetic batch.
+
+    ``warmup`` untimed steps absorb compile time; each timed step blocks on
+    device completion so steps/sec reflects real chip time. ``gate()`` (if
+    given) runs before every step — the isolation client's token round-trip.
+    """
+    key = jax.random.PRNGKey(seed)
+    pkey, bkey = jax.random.split(key)
+    params = init_fn(pkey)
+    optimizer = optimizer or optax.adam(learning_rate)
+    opt_state = optimizer.init(params)
+    step = make_train_step(loss_fn, optimizer)
+    batch = batch_fn(bkey)
+
+    loss = jnp.zeros(())
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        if gate is not None:
+            gate()
+        params, opt_state, loss = step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    return TrainResult(steps=steps, seconds=elapsed, final_loss=float(loss))
+
+
+def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None) -> TrainResult:
+    """Shared ``python -m kubeshare_tpu.models.<name> --steps N`` entry."""
+    import argparse
+
+    parser = argparse.ArgumentParser(prog=f"kubeshare_tpu.models.{model_name}")
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    result = run_training(init_fn, loss_fn, batch_fn, args.steps,
+                          learning_rate=args.lr, seed=args.seed)
+    print(f"{model_name}: {result.steps} steps in {result.seconds:.2f}s "
+          f"= {result.steps_per_sec:.2f} steps/s, final loss {result.final_loss:.4f}")
+    return result

@@ -1,0 +1,50 @@
+"""LSTM language-model workload (≙ the reference's ``lstm-wiki2`` eval
+image, ``test/lstm/``): embedding → 2×LSTM (``lax.scan``) → tied softmax."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (dense_apply, dense_init, lstm_apply, lstm_init,
+                   softmax_cross_entropy)
+from .common import main_cli, synthetic_token_batch
+
+BATCH_SIZE = 32
+SEQ_LEN = 64
+VOCAB = 8192
+EMBED = 256
+HIDDEN = 512
+DTYPE = jnp.bfloat16
+
+
+def init(key) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "embed": jax.random.normal(k1, (VOCAB, EMBED)) * 0.02,
+        "lstm1": lstm_init(k2, EMBED, HIDDEN),
+        "lstm2": lstm_init(k3, HIDDEN, HIDDEN),
+        "out": dense_init(k4, HIDDEN, VOCAB),
+    }
+
+
+def apply(params: dict, tokens: jax.Array) -> jax.Array:
+    x = params["embed"][tokens].astype(DTYPE)
+    x = lstm_apply(params["lstm1"], x, dtype=DTYPE)
+    x = lstm_apply(params["lstm2"], x, dtype=DTYPE)
+    return dense_apply(params["out"], x, dtype=DTYPE)
+
+
+def loss_fn(params: dict, batch) -> jax.Array:
+    tokens, targets = batch
+    return softmax_cross_entropy(apply(params, tokens), targets)
+
+
+batch_fn = partial(synthetic_token_batch, batch_size=BATCH_SIZE,
+                   seq_len=SEQ_LEN, vocab=VOCAB)
+
+
+if __name__ == "__main__":
+    main_cli("lstm", init, loss_fn, batch_fn)

@@ -1,0 +1,54 @@
+"""MNIST-scale workload — the north-star benchmark model.
+
+Counterpart of the reference's ``riyazhu/mnist:test`` eval image
+(``test/mnist/mnist1.yaml:15``): a small conv net on 28×28×1 inputs.
+Activations run in bfloat16 (MXU-native), loss in fp32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (conv2d_apply, conv2d_init, dense_apply, dense_init,
+                   max_pool, softmax_cross_entropy)
+from .common import main_cli, synthetic_image_batch
+
+BATCH_SIZE = 128
+CLASSES = 10
+DTYPE = jnp.bfloat16
+
+
+def init(key) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "conv1": conv2d_init(k1, 1, 32),
+        "conv2": conv2d_init(k2, 32, 64),
+        "fc1": dense_init(k3, 7 * 7 * 64, 256),
+        "fc2": dense_init(k4, 256, CLASSES),
+    }
+
+
+def apply(params: dict, x: jax.Array) -> jax.Array:
+    x = jax.nn.relu(conv2d_apply(params["conv1"], x, dtype=DTYPE))
+    x = max_pool(x)
+    x = jax.nn.relu(conv2d_apply(params["conv2"], x, dtype=DTYPE))
+    x = max_pool(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(dense_apply(params["fc1"], x, dtype=DTYPE))
+    return dense_apply(params["fc2"], x, dtype=DTYPE)
+
+
+def loss_fn(params: dict, batch) -> jax.Array:
+    x, y = batch
+    return softmax_cross_entropy(apply(params, x), y)
+
+
+batch_fn = partial(synthetic_image_batch, batch_size=BATCH_SIZE, hw=28,
+                   channels=1, classes=CLASSES)
+
+
+if __name__ == "__main__":
+    main_cli("mnist", init, loss_fn, batch_fn)

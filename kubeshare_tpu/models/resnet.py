@@ -1,0 +1,83 @@
+"""ResNet-18-style workload (≙ the reference's resnet18/50 torchelastic
+eval jobs, ``test/distribute/default/2gpu/resnet50_1.yaml``): basic residual
+blocks on 32×32×3 inputs, 4 stages of 2 blocks."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (batchnorm_apply, batchnorm_init, conv2d_apply,
+                   conv2d_init, dense_apply, dense_init, softmax_cross_entropy)
+from .common import main_cli, synthetic_image_batch
+
+BATCH_SIZE = 64
+CLASSES = 10
+DTYPE = jnp.bfloat16
+STAGES = (64, 128, 256, 512)
+BLOCKS_PER_STAGE = 2
+
+
+def _block_init(key, in_ch: int, out_ch: int) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = {
+        "conv1": conv2d_init(k1, in_ch, out_ch),
+        "bn1": batchnorm_init(out_ch),
+        "conv2": conv2d_init(k2, out_ch, out_ch),
+        "bn2": batchnorm_init(out_ch),
+    }
+    if in_ch != out_ch:
+        params["proj"] = conv2d_init(k3, in_ch, out_ch, kernel=1)
+    return params
+
+
+def _block_apply(params: dict, x: jax.Array, stride: int) -> jax.Array:
+    y = conv2d_apply(params["conv1"], x, stride=stride, dtype=DTYPE)
+    y = jax.nn.relu(batchnorm_apply(params["bn1"], y.astype(jnp.float32)))
+    y = conv2d_apply(params["conv2"], y, dtype=DTYPE)
+    y = batchnorm_apply(params["bn2"], y.astype(jnp.float32))
+    if "proj" in params:
+        x = conv2d_apply(params["proj"], x, stride=stride, dtype=DTYPE)
+    return jax.nn.relu(y + x.astype(y.dtype))
+
+
+def init(key) -> dict:
+    n_blocks = len(STAGES) * BLOCKS_PER_STAGE
+    keys = jax.random.split(key, n_blocks + 2)
+    params: dict = {"stem": conv2d_init(keys[0], 3, STAGES[0]),
+                    "stem_bn": batchnorm_init(STAGES[0])}
+    in_ch = STAGES[0]
+    ki = 1
+    for s, ch in enumerate(STAGES):
+        for b in range(BLOCKS_PER_STAGE):
+            params[f"s{s}b{b}"] = _block_init(keys[ki], in_ch, ch)
+            in_ch = ch
+            ki += 1
+    params["fc"] = dense_init(keys[-1], STAGES[-1], CLASSES)
+    return params
+
+
+def apply(params: dict, x: jax.Array) -> jax.Array:
+    x = conv2d_apply(params["stem"], x, dtype=DTYPE)
+    x = jax.nn.relu(batchnorm_apply(params["stem_bn"], x.astype(jnp.float32)))
+    for s in range(len(STAGES)):
+        for b in range(BLOCKS_PER_STAGE):
+            stride = 2 if (s > 0 and b == 0) else 1
+            x = _block_apply(params[f"s{s}b{b}"], x, stride)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    return dense_apply(params["fc"], x, dtype=DTYPE)
+
+
+def loss_fn(params: dict, batch) -> jax.Array:
+    x, y = batch
+    return softmax_cross_entropy(apply(params, x), y)
+
+
+batch_fn = partial(synthetic_image_batch, batch_size=BATCH_SIZE, hw=32,
+                   channels=3, classes=CLASSES)
+
+
+if __name__ == "__main__":
+    main_cli("resnet", init, loss_fn, batch_fn)

@@ -1,0 +1,58 @@
+"""VGG-16-style workload (≙ the reference's vgg16 eval jobs,
+``test/distribute/**``): 5 conv stacks + classifier on 32×32×3 inputs."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (conv2d_apply, conv2d_init, dense_apply, dense_init,
+                   max_pool, softmax_cross_entropy)
+from .common import main_cli, synthetic_image_batch
+
+BATCH_SIZE = 64
+CLASSES = 10
+DTYPE = jnp.bfloat16
+# (channels, convs-per-stack) — the VGG-16 configuration
+STACKS = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+
+def init(key) -> dict:
+    n_convs = sum(n for _, n in STACKS)
+    keys = jax.random.split(key, n_convs + 2)
+    params: dict = {}
+    in_ch = 3
+    ki = 0
+    for s, (ch, n) in enumerate(STACKS):
+        for c in range(n):
+            params[f"s{s}c{c}"] = conv2d_init(keys[ki], in_ch, ch)
+            in_ch = ch
+            ki += 1
+    params["fc1"] = dense_init(keys[-2], STACKS[-1][0], 512)
+    params["fc2"] = dense_init(keys[-1], 512, CLASSES)
+    return params
+
+
+def apply(params: dict, x: jax.Array) -> jax.Array:
+    for s, (_, n) in enumerate(STACKS):
+        for c in range(n):
+            x = jax.nn.relu(conv2d_apply(params[f"s{s}c{c}"], x, dtype=DTYPE))
+        x = max_pool(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(dense_apply(params["fc1"], x, dtype=DTYPE))
+    return dense_apply(params["fc2"], x, dtype=DTYPE)
+
+
+def loss_fn(params: dict, batch) -> jax.Array:
+    x, y = batch
+    return softmax_cross_entropy(apply(params, x), y)
+
+
+batch_fn = partial(synthetic_image_batch, batch_size=BATCH_SIZE, hw=32,
+                   channels=3, classes=CLASSES)
+
+
+if __name__ == "__main__":
+    main_cli("vgg", init, loss_fn, batch_fn)

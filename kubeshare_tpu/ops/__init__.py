@@ -1,0 +1,38 @@
+"""Functional NN ops for the workload layer.
+
+The reference ships no NN code of its own — its eval workloads are external
+torch images (mnist/cifar10/lstm/resnet/vgg, ``test/mnist/mnist1.yaml:15``
+and siblings). This framework carries the equivalent workloads in-tree as
+pure-JAX functional ops so benchmarks and isolation tests are reproducible
+without registries, designed TPU-first: static shapes, ``lax`` control flow,
+bfloat16-friendly matmul-heavy layers XLA can tile onto the MXU.
+"""
+
+from .layers import (
+    batchnorm_apply,
+    batchnorm_init,
+    conv2d_apply,
+    conv2d_init,
+    dense_apply,
+    dense_init,
+    lstm_apply,
+    lstm_init,
+    avg_pool,
+    max_pool,
+)
+from .losses import accuracy, softmax_cross_entropy
+
+__all__ = [
+    "accuracy",
+    "avg_pool",
+    "batchnorm_apply",
+    "batchnorm_init",
+    "conv2d_apply",
+    "conv2d_init",
+    "dense_apply",
+    "dense_init",
+    "lstm_apply",
+    "lstm_init",
+    "max_pool",
+    "softmax_cross_entropy",
+]

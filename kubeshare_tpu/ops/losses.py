@@ -1,0 +1,20 @@
+"""Classification losses/metrics (fp32 accumulation regardless of
+activation dtype — bf16 logits are fine, bf16 log-sum-exp is not)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean cross entropy; ``labels`` are integer class ids of any rank
+    (``logits`` carry one trailing class axis more)."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))

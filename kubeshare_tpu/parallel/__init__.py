@@ -1,0 +1,19 @@
+"""Mesh/sharding utilities for multi-chip gangs."""
+
+from .mesh import (
+    data_sharding,
+    make_mesh,
+    make_sharded_train_step,
+    param_sharding,
+    replicated,
+    shard_init,
+)
+
+__all__ = [
+    "data_sharding",
+    "make_mesh",
+    "make_sharded_train_step",
+    "param_sharding",
+    "replicated",
+    "shard_init",
+]

@@ -262,6 +262,11 @@ def _bind_chips(node_cell: Cell, chips_by_node: dict[str, dict[str, list[ChipInf
             "node %s: config has %d more %s leaves than discovery reported "
             "(%d chips); unbound leaves zeroed out",
             node_name, unbound, node_cell.leaf_cell_type, len(chips))
+    elif idx < len(chips):
+        get_logger("topology").warning(
+            "node %s: discovery reported %d %s chips but config only has %d "
+            "leaves; surplus chips unused",
+            node_name, len(chips), node_cell.leaf_cell_type, idx)
     for cell in node_cell.walk():
         cell.state = CELL_FILLED
     cur = node_cell.parent

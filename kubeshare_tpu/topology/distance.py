@@ -59,9 +59,16 @@ def ici_distance(a: tuple[int, ...], b: tuple[int, ...],
     """
     if len(a) != len(b):
         common = min(len(a), len(b))
-        # Torus wraparound still applies to the common trailing axes: the
-        # mesh_shape suffix aligns with the coordinate suffix.
-        suffix_shape = mesh_shape[-common:] if mesh_shape else None
+        # Torus wraparound still applies to the common trailing axes.
+        # mesh_shape is head-aligned with the longer tuple (same convention
+        # as the equal-rank loop below: axis i has size mesh_shape[i],
+        # unbounded past the end), so the suffix axes start at `offset`.
+        offset = max(len(a), len(b)) - common
+        suffix_shape = None
+        if mesh_shape is not None:
+            suffix_shape = tuple(
+                mesh_shape[offset + j] if offset + j < len(mesh_shape) else 0
+                for j in range(common))
         return DCN_PENALTY * abs(len(a) - len(b)) + ici_distance(
             a[-common:], b[-common:], suffix_shape)
     total = 0.0
