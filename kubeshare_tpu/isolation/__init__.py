@@ -1,0 +1,18 @@
+"""Fractional-isolation runtime: time-slice one TPU chip between clients.
+
+TPU-native re-design of the reference's Gemini stack (gem-schd token
+scheduler + gem-pmgr pod managers + LD_PRELOAD CUDA hook; integration
+surface at ``docker/kubeshare-gemini-scheduler/launcher.py`` and
+``pkg/scheduler/pod.go:435-474``). A TPU chip is single-tenant per process
+at the libtpu level, so interception becomes *proxying*: one resident
+:mod:`proxy` process owns the chip; client pods talk to their per-pod
+manager (:mod:`podmanager`), which relays execution through the proxy under
+the :mod:`tokensched` token scheduler's quota/window regime.
+"""
+
+from .tokensched import (NativeTokenCore, PyTokenCore, TokenScheduler,
+                         make_core, serve)
+
+__all__ = [
+    "NativeTokenCore", "PyTokenCore", "TokenScheduler", "make_core", "serve",
+]

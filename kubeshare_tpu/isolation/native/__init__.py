@@ -1,0 +1,47 @@
+"""Native (C++) cores of the isolation runtime, built on demand with g++.
+
+The reference's isolation runtime is native C++ (the Gemini submodule,
+built by ``docker/kubeshare-gemini-scheduler/Dockerfile:15-18``); the
+TPU-native equivalents keep the hot accounting core native and the process
+orchestration in Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+
+
+def build_library(name: str) -> str | None:
+    """Compile ``<name>.cpp`` into ``_build/lib<name>.so`` (cached by mtime).
+
+    Returns the .so path, or None when no C++ toolchain is available —
+    callers fall back to their pure-Python implementation.
+    """
+    src = os.path.join(_HERE, f"{name}.cpp")
+    build_dir = os.path.join(_HERE, "_build")
+    lib = os.path.join(build_dir, f"lib{name}.so")
+    with _BUILD_LOCK:
+        if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
+            return lib
+        os.makedirs(build_dir, exist_ok=True)
+        cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", lib, src]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except (FileNotFoundError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            from ...utils.logger import get_logger
+            get_logger("isolation").warning(
+                "native build of %s failed (%s); using Python fallback", name, detail)
+            return None
+    return lib
+
+
+def load_library(name: str) -> ctypes.CDLL | None:
+    lib = build_library(name)
+    return ctypes.CDLL(lib) if lib else None

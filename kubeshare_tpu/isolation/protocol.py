@@ -1,0 +1,130 @@
+"""Framed-JSON socket protocol shared by the isolation components.
+
+The reference's runtime wires hook ⇄ gem-pmgr ⇄ gem-schd over localhost TCP
+(env ``SCHEDULER_IP/PORT``, ``POD_MANAGER_IP/PORT`` —
+``docker/kubeshare-gemini-scheduler/launcher.py:13-19``). Same shape here:
+every message is a 4-byte big-endian length followed by a UTF-8 JSON object.
+Binary payloads (device buffers crossing the proxy boundary) ride as a raw
+byte blob after the JSON header, announced by ``_blob`` (its byte length).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+
+_HDR = struct.Struct(">I")
+MAX_FRAME = 1 << 30
+
+
+class ProtocolError(ConnectionError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ProtocolError("peer closed mid-frame" if buf else "peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, msg: dict, blob: bytes | None = None) -> None:
+    if blob is not None:
+        msg = dict(msg, _blob=len(blob))
+    data = json.dumps(msg).encode()
+    if len(data) > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {len(data)}")
+    sock.sendall(_HDR.pack(len(data)) + data + (blob or b""))
+
+
+def recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:
+    (size,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if size > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {size}")
+    msg = json.loads(_recv_exact(sock, size))
+    blob = None
+    if "_blob" in msg:
+        blob = _recv_exact(sock, int(msg.pop("_blob")))
+    return msg, blob
+
+
+class Connection:
+    """Client-side request/reply channel."""
+
+    def __init__(self, host: str, port: int, timeout: float | None = None):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, msg: dict, blob: bytes | None = None) -> tuple[dict, bytes | None]:
+        with self._lock:
+            send_msg(self.sock, msg, blob)
+            reply, rblob = recv_msg(self.sock)
+        if not reply.get("ok", False):
+            raise RuntimeError(reply.get("error", "remote error"))
+        return reply, rblob
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FramedServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_framed(host: str, port: int, handle, cleanup=None) -> FramedServer:
+    """Start a threaded framed-JSON server.
+
+    ``handle(request: dict, state: dict) -> dict`` runs per message on the
+    connection's thread (``state`` is per-connection, with ``_blob`` bytes
+    under ``state['blob']`` when present and reply blobs via
+    ``state['reply_blob']``); ``cleanup(state)`` runs on disconnect. Returns
+    the running server — caller owns ``server.shutdown()``; the bound port
+    is ``server.server_address[1]``.
+    """
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            state: dict = {}
+            try:
+                while True:
+                    try:
+                        msg, blob = recv_msg(self.request)
+                    except (ProtocolError, OSError):
+                        break
+                    state["blob"] = blob
+                    state.pop("reply_blob", None)
+                    try:
+                        reply = handle(msg, state)
+                    except Exception as e:  # surfaced to the caller
+                        reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                    try:
+                        send_msg(self.request, reply, state.get("reply_blob"))
+                    except OSError:
+                        break
+            finally:
+                if cleanup is not None:
+                    cleanup(state)
+
+    server = FramedServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name=f"framed-server-{server.server_address[1]}")
+    thread.start()
+    return server

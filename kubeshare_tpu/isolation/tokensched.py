@@ -1,0 +1,405 @@
+"""Token scheduler: time-slices one chip between fractional clients.
+
+Re-design of the reference's per-GPU gem-schd (native C++, CLI
+``-q 300 -m 20 -w 10000`` — ``docker/kubeshare-gemini-scheduler/
+launcher.py:75-80``). One exclusive *token* circulates per chip; a grant
+carries a quota (ms of device time), the holder reports actual usage on
+release. Scheduling = stride scheduling weighted by ``tpu_request`` with a
+sliding-window ``tpu_limit`` cap (see ``native/tokensched.cpp`` header for
+the algorithm statement).
+
+Two interchangeable cores — the native C++ library (default) and a pure
+Python :class:`PyTokenCore` (fallback + executable spec, cross-checked by
+``tests/test_tokensched.py``) — and a blocking façade
+:class:`TokenScheduler` plus a TCP server (:func:`serve`) speaking the
+framed-JSON protocol that pod managers use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
+from ..utils.logger import get_logger
+from . import protocol
+from .native import load_library
+
+log = get_logger("tokensched")
+
+_INF = float("inf")
+
+
+# --------------------------------------------------------------------------
+# Pure-Python core (executable spec / fallback)
+# --------------------------------------------------------------------------
+
+@dataclass
+class _PyClient:
+    name: str
+    request: float
+    limit: float
+    vtime: float = 0.0
+    waiting: bool = False
+    usage: list = field(default_factory=list)  # [(start_ms, end_ms)]
+
+    def window_usage(self, now_ms: float, window_ms: float) -> float:
+        lo = now_ms - window_ms
+        self.usage = [(s, e) for s, e in self.usage if e > lo]
+        return sum(e - max(s, lo) for s, e in self.usage)
+
+    def eligible_at(self, now_ms: float, window_ms: float, target_ms: float) -> float:
+        if self.window_usage(now_ms, window_ms) <= target_ms:
+            return now_ms
+        lo, hi = now_ms, now_ms + window_ms
+        for _ in range(48):
+            mid = (lo + hi) / 2
+            wlo = mid - window_ms
+            total = sum(e - max(s, wlo) for s, e in self.usage if e > wlo)
+            if total <= target_ms:
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+
+class PyTokenCore:
+    """Same state machine as the native core, in Python."""
+
+    def __init__(self, window_ms: float = WINDOW_MS,
+                 base_quota_ms: float = BASE_QUOTA_MS,
+                 min_quota_ms: float = MIN_QUOTA_MS):
+        self.window_ms = window_ms
+        self.base_quota_ms = base_quota_ms
+        self.min_quota_ms = min_quota_ms
+        self._clients: dict[str, _PyClient] = {}
+        self._holder: str | None = None
+
+    def add_client(self, name: str, request: float, limit: float) -> None:
+        if request <= 0 or limit <= 0 or limit > 1 or request > limit:
+            raise ValueError(f"bad request/limit: {request}/{limit}")
+        if name in self._clients:
+            raise ValueError(f"duplicate client {name}")
+        vmin = min((c.vtime for c in self._clients.values()), default=0.0)
+        self._clients[name] = _PyClient(name, request, limit, vtime=vmin)
+
+    def remove_client(self, name: str) -> None:
+        self._clients.pop(name, None)
+        if self._holder == name:
+            self._holder = None
+
+    def request_token(self, name: str) -> None:
+        self._clients[name].waiting = True
+
+    def cancel_request(self, name: str) -> None:
+        client = self._clients.get(name)
+        if client is not None:
+            client.waiting = False
+
+    def poll(self, now_ms: float) -> tuple[str, float] | float:
+        """Grant ``(name, quota_ms)`` or return the next wake time (ms,
+        may be inf)."""
+        if self._holder is not None:
+            return _INF
+        best: _PyClient | None = None
+        best_remaining = 0.0
+        next_wake = _INF
+        for c in self._clients.values():
+            if not c.waiting:
+                continue
+            cap = c.limit * self.window_ms
+            remaining = cap - c.window_usage(now_ms, self.window_ms)
+            if remaining < self.min_quota_ms:
+                next_wake = min(next_wake, c.eligible_at(
+                    now_ms, self.window_ms, cap - self.min_quota_ms))
+                continue
+            if best is None or c.vtime < best.vtime:
+                best, best_remaining = c, remaining
+        if best is None:
+            return next_wake
+        quota = max(self.min_quota_ms, min(self.base_quota_ms, best_remaining))
+        best.waiting = False
+        self._holder = best.name
+        return best.name, quota
+
+    def release_token(self, name: str, used_ms: float, now_ms: float) -> None:
+        if self._holder != name:
+            raise ValueError(f"{name} does not hold the token")
+        c = self._clients[name]
+        if used_ms > 0:
+            c.usage.append((now_ms - used_ms, now_ms))
+            c.vtime += used_ms / c.request
+        self._holder = None
+
+    def window_usage(self, name: str, now_ms: float) -> float:
+        return self._clients[name].window_usage(now_ms, self.window_ms)
+
+    def holder(self) -> str | None:
+        return self._holder
+
+    def client_count(self) -> int:
+        return len(self._clients)
+
+    def close(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------------------
+# Native core (ctypes over native/tokensched.cpp)
+# --------------------------------------------------------------------------
+
+class NativeTokenCore:
+    """ctypes wrapper over ``libtokensched.so`` with PyTokenCore's interface."""
+
+    def __init__(self, window_ms: float = WINDOW_MS,
+                 base_quota_ms: float = BASE_QUOTA_MS,
+                 min_quota_ms: float = MIN_QUOTA_MS, _lib=None):
+        lib = _lib if _lib is not None else load_library("tokensched")
+        if lib is None:
+            raise RuntimeError("native tokensched unavailable")
+        self._lib = lib
+        lib.ts_create.restype = ctypes.c_void_p
+        lib.ts_create.argtypes = [ctypes.c_double] * 3
+        lib.ts_destroy.argtypes = [ctypes.c_void_p]
+        lib.ts_add_client.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_double, ctypes.c_double]
+        lib.ts_remove_client.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_request_token.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_cancel_request.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ts_poll.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                ctypes.c_char_p, ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_double),
+                                ctypes.POINTER(ctypes.c_double)]
+        lib.ts_release_token.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_double, ctypes.c_double]
+        lib.ts_window_usage.restype = ctypes.c_double
+        lib.ts_window_usage.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_double]
+        lib.ts_client_count.argtypes = [ctypes.c_void_p]
+        lib.ts_holder.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        self._h = lib.ts_create(window_ms, base_quota_ms, min_quota_ms)
+        self.window_ms = window_ms
+        self.base_quota_ms = base_quota_ms
+        self.min_quota_ms = min_quota_ms
+
+    def add_client(self, name: str, request: float, limit: float) -> None:
+        rc = self._lib.ts_add_client(self._h, name.encode(), request, limit)
+        if rc == -1:
+            raise ValueError(f"bad request/limit: {request}/{limit}")
+        if rc == -2:
+            raise ValueError(f"duplicate client {name}")
+
+    def remove_client(self, name: str) -> None:
+        self._lib.ts_remove_client(self._h, name.encode())
+
+    def request_token(self, name: str) -> None:
+        if self._lib.ts_request_token(self._h, name.encode()) != 0:
+            raise KeyError(name)
+
+    def cancel_request(self, name: str) -> None:
+        self._lib.ts_cancel_request(self._h, name.encode())
+
+    def poll(self, now_ms: float):
+        buf = ctypes.create_string_buffer(256)
+        quota = ctypes.c_double()
+        wake = ctypes.c_double()
+        rc = self._lib.ts_poll(self._h, now_ms, buf, len(buf),
+                               ctypes.byref(quota), ctypes.byref(wake))
+        if rc == 1:
+            return buf.value.decode(), quota.value
+        return wake.value
+
+    def release_token(self, name: str, used_ms: float, now_ms: float) -> None:
+        if self._lib.ts_release_token(self._h, name.encode(), used_ms, now_ms) != 0:
+            raise ValueError(f"{name} does not hold the token")
+
+    def window_usage(self, name: str, now_ms: float) -> float:
+        u = self._lib.ts_window_usage(self._h, name.encode(), now_ms)
+        if u < 0:
+            raise KeyError(name)
+        return u
+
+    def holder(self) -> str | None:
+        buf = ctypes.create_string_buffer(256)
+        if self._lib.ts_holder(self._h, buf, len(buf)):
+            return buf.value.decode()
+        return None
+
+    def client_count(self) -> int:
+        return self._lib.ts_client_count(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ts_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_core(window_ms: float = WINDOW_MS, base_quota_ms: float = BASE_QUOTA_MS,
+              min_quota_ms: float = MIN_QUOTA_MS, native: bool | None = None):
+    """Build the native core when available (or demanded), else Python."""
+    if native is not False:
+        try:
+            return NativeTokenCore(window_ms, base_quota_ms, min_quota_ms)
+        except RuntimeError:
+            if native:
+                raise
+    return PyTokenCore(window_ms, base_quota_ms, min_quota_ms)
+
+
+# --------------------------------------------------------------------------
+# Blocking façade + TCP server
+# --------------------------------------------------------------------------
+
+def _now_ms() -> float:
+    return time.monotonic() * 1000.0
+
+
+class TokenScheduler:
+    """Thread-safe blocking façade over a core: ``acquire`` blocks until the
+    token is granted, ``release`` reports usage and wakes the next waiter."""
+
+    def __init__(self, window_ms: float = WINDOW_MS,
+                 base_quota_ms: float = BASE_QUOTA_MS,
+                 min_quota_ms: float = MIN_QUOTA_MS, native: bool | None = None,
+                 clock=None):
+        self._core = make_core(window_ms, base_quota_ms, min_quota_ms, native)
+        self._cond = threading.Condition()
+        self._grants: dict[str, float] = {}  # name -> granted quota_ms
+        self._clock = clock or _now_ms
+        self.window_ms = window_ms
+
+    @property
+    def core(self):
+        return self._core
+
+    def add_client(self, name: str, request: float, limit: float) -> None:
+        with self._cond:
+            self._core.add_client(name, request, limit)
+
+    def remove_client(self, name: str) -> None:
+        with self._cond:
+            self._core.remove_client(name)
+            self._grants.pop(name, None)
+            self._cond.notify_all()
+
+    def acquire(self, name: str, timeout: float | None = None) -> float:
+        """Block until *name* is granted the token; returns quota_ms."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._core.request_token(name)
+            return self._wait_for_grant(name, deadline)
+
+    def renew(self, name: str, used_ms: float, timeout: float | None = None) -> float:
+        """Atomically release + re-request + wait for the next grant.
+
+        This is the steady-state client call (≙ the hook re-requesting when
+        its quota runs out while kernels keep coming): the release and the
+        re-request happen under one lock acquisition, so this client is
+        *waiting* when the freed token is handed out and stride weighting
+        decides the order — a release-then-acquire pair instead would hand
+        the token to whoever else happened to be waiting in the gap,
+        collapsing shares to round-robin.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._core.release_token(name, used_ms, self._clock())
+            self._core.request_token(name)
+            self._cond.notify_all()
+            return self._wait_for_grant(name, deadline)
+
+    def _wait_for_grant(self, name: str, deadline: float | None) -> float:
+        # Caller holds self._cond.
+        while True:
+            result = self._core.poll(self._clock())
+            if isinstance(result, tuple):
+                granted, quota = result
+                self._grants[granted] = quota
+                self._cond.notify_all()
+            if name in self._grants:
+                return self._grants.pop(name)
+            wait: float | None
+            if isinstance(result, tuple) or result == _INF:
+                wait = None
+            else:
+                wait = max(0.001, (result - self._clock()) / 1000.0)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # Withdraw cleanly: consume-and-return a grant that
+                    # raced in, else clear the waiting flag so the core
+                    # never hands out a token nobody will consume.
+                    if name in self._grants:
+                        return self._grants.pop(name)
+                    self._core.cancel_request(name)
+                    raise TimeoutError(f"{name}: token wait timed out")
+                wait = remaining if wait is None else min(wait, remaining)
+            self._cond.wait(wait)
+
+    def release(self, name: str, used_ms: float) -> None:
+        with self._cond:
+            self._core.release_token(name, used_ms, self._clock())
+            self._cond.notify_all()
+
+    def window_usage(self, name: str) -> float:
+        with self._cond:
+            return self._core.window_usage(name, self._clock())
+
+    def close(self) -> None:
+        with self._cond:
+            self._core.close()
+
+
+def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
+    """Expose a :class:`TokenScheduler` over framed-JSON TCP.
+
+    Requests: ``{"op": "register", "name", "request", "limit"}``,
+    ``{"op": "acquire", "name"}`` (blocks; reply carries ``quota_ms``),
+    ``{"op": "renew", "name", "used_ms"}`` (atomic release+reacquire — the
+    steady-state call), ``{"op": "release", "name", "used_ms"}``,
+    ``{"op": "usage", "name"}``,
+    ``{"op": "unregister", "name"}``. Replies: ``{"ok": true, ...}`` or
+    ``{"ok": false, "error": msg}``. One connection per pod manager; the
+    server cleans up the client on disconnect (≙ gem-schd dropping a dead
+    pod manager).
+    """
+    def handle(req: dict, state: dict) -> dict:
+        op = req.get("op")
+        name = req.get("name", "")
+        if op == "register":
+            scheduler.add_client(name, float(req["request"]), float(req["limit"]))
+            state["name"] = name
+            return {"ok": True}
+        if op == "acquire":
+            quota = scheduler.acquire(name, timeout=req.get("timeout"))
+            return {"ok": True, "quota_ms": quota}
+        if op == "renew":
+            quota = scheduler.renew(name, float(req["used_ms"]),
+                                    timeout=req.get("timeout"))
+            return {"ok": True, "quota_ms": quota}
+        if op == "release":
+            scheduler.release(name, float(req["used_ms"]))
+            return {"ok": True}
+        if op == "usage":
+            return {"ok": True,
+                    "used_ms": scheduler.window_usage(name),
+                    "window_ms": scheduler.window_ms}
+        if op == "unregister":
+            scheduler.remove_client(name)
+            state.pop("name", None)
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def cleanup(state: dict) -> None:
+        name = state.get("name")
+        if name:
+            scheduler.remove_client(name)
+
+    return protocol.serve_framed(host, port, handle, cleanup)

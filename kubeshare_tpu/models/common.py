@@ -31,15 +31,29 @@ class TrainResult:
         return self.steps / self.seconds if self.seconds > 0 else 0.0
 
 
-def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
+def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
+                    constrain_params: Callable | None = None,
+                    constrain_batch: Callable | None = None):
     """``loss_fn(params, batch) -> scalar`` → jitted
-    ``step(params, opt_state, batch) -> (params, opt_state, loss)``."""
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    The optional ``constrain_*`` hooks apply sharding constraints on the way
+    in and out — the multi-chip path (``parallel.mesh``) plugs its mesh
+    layouts in here so single-chip and sharded benchmarks share one step
+    body.
+    """
 
     @jax.jit
     def step(params, opt_state, batch):
+        if constrain_params is not None:
+            params = constrain_params(params)
+        if constrain_batch is not None:
+            batch = constrain_batch(batch)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if constrain_params is not None:
+            params = constrain_params(params)
         return params, opt_state, loss
 
     return step

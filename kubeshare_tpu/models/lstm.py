@@ -1,5 +1,6 @@
 """LSTM language-model workload (≙ the reference's ``lstm-wiki2`` eval
-image, ``test/lstm/``): embedding → 2×LSTM (``lax.scan``) → tied softmax."""
+image, ``test/lstm/``): embedding → 2×LSTM (``lax.scan``) → softmax
+projection (untied — EMBED and HIDDEN differ)."""
 
 from __future__ import annotations
 

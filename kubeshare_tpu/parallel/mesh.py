@@ -55,11 +55,11 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def param_sharding(mesh: Mesh, params) -> "jax.tree_util.PyTreeDef":
-    """Tensor-parallel parameter layout: matrices (ndim ≥ 2) are split on
-    their last axis over tp when divisible (dense/conv output channels —
-    the MXU-friendly Megatron-style column split); everything else is
-    replicated."""
+def param_sharding(mesh: Mesh, params):
+    """Tensor-parallel parameter layout: a pytree of :class:`NamedSharding`
+    mirroring ``params``. Matrices (ndim ≥ 2) are split on their last axis
+    over tp when divisible (dense/conv output channels — the MXU-friendly
+    Megatron-style column split); everything else is replicated."""
     tp = mesh.shape["tp"]
 
     def shard_leaf(x):
@@ -76,21 +76,19 @@ def make_sharded_train_step(loss_fn: Callable, optimizer, mesh: Mesh):
     constrained to :func:`data_sharding` and params to
     :func:`param_sharding` on the way in and out, so the layout holds even
     for host-resident inputs. XLA inserts the psum for dp gradient
-    reduction and the tp collectives from the shardings."""
+    reduction and the tp collectives from the shardings. One step body with
+    the single-chip path (``models.common.make_train_step``)."""
+    from ..models.common import make_train_step
 
     def constrain_params(params):
         return jax.lax.with_sharding_constraint(params, param_sharding(mesh, params))
 
-    @jax.jit
-    def step(params, opt_state, batch):
-        params = constrain_params(params)
-        batch = jax.lax.with_sharding_constraint(batch, data_sharding(mesh))
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = constrain_params(optax.apply_updates(params, updates))
-        return params, opt_state, loss
+    def constrain_batch(batch):
+        return jax.lax.with_sharding_constraint(batch, data_sharding(mesh))
 
-    return step
+    return make_train_step(loss_fn, optimizer,
+                           constrain_params=constrain_params,
+                           constrain_batch=constrain_batch)
 
 
 def shard_init(init_fn: Callable, key, mesh: Mesh):
