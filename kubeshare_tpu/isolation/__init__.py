@@ -5,14 +5,20 @@ scheduler + gem-pmgr pod managers + LD_PRELOAD CUDA hook; integration
 surface at ``docker/kubeshare-gemini-scheduler/launcher.py`` and
 ``pkg/scheduler/pod.go:435-474``). A TPU chip is single-tenant per process
 at the libtpu level, so interception becomes *proxying*: one resident
-:mod:`proxy` process owns the chip; client pods talk to their per-pod
-manager (:mod:`podmanager`), which relays execution through the proxy under
-the :mod:`tokensched` token scheduler's quota/window regime.
+:mod:`proxy` process owns the chip and executes client-submitted StableHLO
+programs under the :mod:`tokensched` token scheduler's quota/window regime;
+client pods use :mod:`client` (buffer handles + traced programs), with
+token traffic relayed by their per-pod manager (:mod:`podmgr`).
 """
 
+from .client import ExecutionGate, ProxyClient, RemoteBuffer, RemoteExecutable
+from .podmgr import PodManager
+from .proxy import ChipProxy
 from .tokensched import (NativeTokenCore, PyTokenCore, TokenScheduler,
                          make_core, serve)
 
 __all__ = [
-    "NativeTokenCore", "PyTokenCore", "TokenScheduler", "make_core", "serve",
+    "ChipProxy", "ExecutionGate", "NativeTokenCore", "PodManager",
+    "ProxyClient", "PyTokenCore", "RemoteBuffer", "RemoteExecutable",
+    "TokenScheduler", "make_core", "serve",
 ]

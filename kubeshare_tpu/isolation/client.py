@@ -1,0 +1,248 @@
+"""Client side of the isolation runtime.
+
+Two pieces, matching the reference's two client obligations
+(``pkg/scheduler/pod.go:445-457`` injects both):
+
+- :class:`ProxyClient` — the stand-in for the chip itself. The workload
+  process runs JAX on its CPU backend, traces its step with ``jax.export``,
+  and ships programs + buffers to the :class:`~.proxy.ChipProxy`; tensors
+  live on the proxy as handles (:class:`RemoteBuffer`), so a training loop
+  transfers parameters once. This replaces ``libgemhook.so.1``'s CUDA
+  interception — a TPU client never owns the chip.
+- :class:`ExecutionGate` — the token round-trip for processes that *do* own
+  a chip (whole-chip pods, or the proxy itself): call it before every step;
+  it acquires quota from its pod manager / token scheduler, measures the
+  inter-call elapsed time as device usage, and renews when the quota runs
+  dry — exactly the hook ⇄ gem-pmgr ⇄ gem-schd loop
+  (``docker/kubeshare-gemini-scheduler/launcher.py:13-19``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.logger import get_logger
+from . import protocol
+from .protocol import dump_array, load_array
+
+log = get_logger("client")
+
+
+@dataclass(frozen=True)
+class RemoteBuffer:
+    """A device-resident array on the proxy."""
+
+    handle: int
+    shape: tuple[int, ...]
+    dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n * np.dtype(self.dtype).itemsize
+
+
+class RemoteExecutable:
+    """A compiled program on the proxy; call with pytrees of
+    :class:`RemoteBuffer` (or host arrays, which are uploaded per call)."""
+
+    def __init__(self, client: "ProxyClient", exec_id: int, in_tree, out_tree,
+                 out_meta: list[tuple[list[int], str]]):
+        self._client = client
+        self._exec_id = exec_id
+        self._in_tree = in_tree
+        self._out_tree = out_tree
+        self.out_meta = out_meta
+
+    def __call__(self, *args, donate: bool = False):
+        import jax
+        leaves = jax.tree_util.tree_leaves(args)
+        bufs, uploaded = [], []
+        for leaf in leaves:
+            if isinstance(leaf, RemoteBuffer):
+                bufs.append(leaf)
+            else:
+                buf = self._client.put(leaf)
+                bufs.append(buf)
+                uploaded.append(buf)
+        # donate=True donates every argument (uploaded ones included);
+        # otherwise per-call uploads are freed here — the caller never sees
+        # their handles, so nobody else can.
+        handles = self._client._execute(
+            self._exec_id, [b.handle for b in bufs],
+            donate=[b.handle for b in bufs] if donate else ())
+        if not donate and uploaded:
+            self._client.free(*uploaded)
+        out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
+                    for h, (shape, dtype) in zip(handles, self.out_meta)]
+        return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
+
+
+class ProxyClient:
+    """Connection to a :class:`~.proxy.ChipProxy` for one named client."""
+
+    def __init__(self, host: str, port: int, name: str, request: float,
+                 limit: float, memory: int = 0, timeout: float | None = None):
+        self.name = name
+        self._conn = protocol.Connection(host, port, timeout=timeout)
+        reply, _ = self._conn.call({
+            "op": "register", "name": name, "request": request,
+            "limit": limit, "memory": memory})
+        self.platforms: list[str] = reply["platforms"]
+        self.device: str = reply.get("device", "")
+
+    # -- buffers -------------------------------------------------------------
+
+    def put(self, array) -> RemoteBuffer:
+        arr = np.asarray(array)
+        reply, _ = self._conn.call({"op": "put", "name": self.name},
+                                   blob=dump_array(arr))
+        return RemoteBuffer(reply["handle"], tuple(reply["shape"]),
+                            reply["dtype"])
+
+    def get(self, buf: RemoteBuffer) -> np.ndarray:
+        _, blob = self._conn.call({"op": "get", "name": self.name,
+                                   "handle": buf.handle})
+        assert blob is not None
+        return load_array(blob)
+
+    def free(self, *bufs) -> None:
+        import jax
+        handles = [b.handle for b in jax.tree_util.tree_leaves(bufs)
+                   if isinstance(b, RemoteBuffer)]
+        if handles:
+            self._conn.call({"op": "free", "name": self.name,
+                             "handles": handles})
+
+    def put_tree(self, tree):
+        """Upload a pytree of host arrays → same-shaped tree of buffers."""
+        import jax
+        return jax.tree_util.tree_map(self.put, tree)
+
+    def get_tree(self, tree):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda b: self.get(b) if isinstance(b, RemoteBuffer) else b, tree)
+
+    # -- programs ------------------------------------------------------------
+
+    def compile(self, fn, *example_args) -> RemoteExecutable:
+        """Trace ``fn`` locally (abstract — no local execution), serialize,
+        and compile it on the proxy's chip.
+
+        ``example_args`` may contain host arrays, :class:`RemoteBuffer`\\ s,
+        or ``jax.ShapeDtypeStruct``\\ s — only shapes/dtypes matter.
+        """
+        import jax
+        from jax import export
+
+        def spec(leaf):
+            if isinstance(leaf, RemoteBuffer):
+                return jax.ShapeDtypeStruct(leaf.shape, np.dtype(leaf.dtype))
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return leaf
+            arr = np.asarray(leaf)
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+        flat_specs, in_tree = jax.tree_util.tree_flatten(
+            jax.tree_util.tree_map(spec, example_args))
+        out_tree_store = []
+
+        def flat_fn(*leaves):
+            args = jax.tree_util.tree_unflatten(in_tree, leaves)
+            out = fn(*args)
+            out_leaves, out_tree = jax.tree_util.tree_flatten(out)
+            out_tree_store.append(out_tree)
+            return tuple(out_leaves)
+
+        exported = export.export(
+            jax.jit(flat_fn),
+            platforms=sorted(set(self.platforms) | {"cpu"}))(*flat_specs)
+        reply, _ = self._conn.call({"op": "compile", "name": self.name},
+                                   blob=exported.serialize())
+        return RemoteExecutable(self, reply["exec_id"], in_tree,
+                                out_tree_store[0], reply["out_meta"])
+
+    def _execute(self, exec_id: int, handles: list[int],
+                 donate=()) -> list[int]:
+        reply, _ = self._conn.call({"op": "execute", "name": self.name,
+                                    "exec_id": exec_id, "args": handles,
+                                    "donate": list(donate)})
+        return list(reply["handles"])
+
+    def usage(self) -> dict:
+        reply, _ = self._conn.call({"op": "usage", "name": self.name})
+        return reply
+
+    def close(self) -> None:
+        try:
+            self._conn.call({"op": "unregister", "name": self.name})
+        except Exception:
+            pass
+        self._conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ExecutionGate:
+    """Token gate for a chip-owning process (hook parity).
+
+    Call the gate before every step; the elapsed time between a call's
+    return and the next call is accounted as device usage (the loop blocks
+    on device completion each step, so wall ≈ device time — the same
+    estimate Gemini's hook makes around kernel bursts). The gate acquires a
+    quota on first use and renews — atomically release + re-request — when
+    the measured usage exhausts it.
+    """
+
+    def __init__(self, conn: protocol.Connection, name: str):
+        self._conn = conn
+        self.name = name
+        self._quota_ms = 0.0
+        self._used_ms = 0.0
+        self._last: float | None = None
+
+    def __call__(self) -> None:
+        now = time.monotonic() * 1000.0
+        if self._last is not None:
+            self._used_ms += now - self._last
+        if self._quota_ms <= 0.0:
+            reply, _ = self._conn.call({"op": "acquire", "name": self.name})
+            self._quota_ms = reply["quota_ms"]
+            self._used_ms = 0.0
+        elif self._used_ms >= self._quota_ms:
+            reply, _ = self._conn.call({"op": "renew", "name": self.name,
+                                        "used_ms": self._used_ms})
+            self._quota_ms = reply["quota_ms"]
+            self._used_ms = 0.0
+        self._last = time.monotonic() * 1000.0
+
+    def close(self) -> None:
+        if self._quota_ms > 0.0:
+            now = time.monotonic() * 1000.0
+            if self._last is not None:
+                self._used_ms += now - self._last
+            try:
+                self._conn.call({"op": "release", "name": self.name,
+                                 "used_ms": self._used_ms})
+            except Exception:
+                pass
+            self._quota_ms = 0.0
+
+    @classmethod
+    def connect(cls, host: str, port: int, name: str, request: float,
+                limit: float) -> "ExecutionGate":
+        """Dial a pod manager / token scheduler and register."""
+        conn = protocol.Connection(host, port)
+        conn.call({"op": "register", "name": name, "request": request,
+                   "limit": limit})
+        return cls(conn, name)

@@ -1,0 +1,150 @@
+"""Per-pod manager: the bridge between a workload's gate and the chip's
+token scheduler.
+
+Parity with gem-pmgr: one process per sharing pod, spawned/killed by the
+node launcher as the pod appears/disappears in the per-chip client list
+(``docker/kubeshare-gemini-scheduler/launcher.py:34-66``), configured by
+env ``SCHEDULER_IP``/``SCHEDULER_PORT``/``POD_MANAGER_PORT``/``POD_NAME``
+(``launcher.py:13-19``). The workload's :class:`~.client.ExecutionGate`
+dials ``POD_MANAGER_PORT``; the manager holds one upstream connection to
+the token scheduler, registers the pod on startup, relays token traffic,
+and unregisters on exit — so a dead pod manager (crashed pod) frees the
+pod's share without scheduler-side timeouts.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..constants import ENV_POD_MANAGER_PORT, ENV_POD_NAME
+from ..utils.logger import get_logger
+from . import protocol
+
+log = get_logger("podmgr")
+
+
+class PodManager:
+    """Relay server: workload gate ⇄ (this) ⇄ token scheduler.
+
+    Each downstream (gate) connection gets its own upstream connection to
+    the scheduler, attached to the pod's one registered client — a single
+    shared upstream would deadlock the chip the moment two gate connections
+    exist (a blocked ``acquire`` holds the channel, so the other gate's
+    ``release`` can never get through). Per-connection token state is
+    tracked so a workload that dies while *holding* the token has it
+    released on disconnect (a crashed pod must not starve the chip —
+    gem-pmgr's kill path, ``launcher.py:58-66``).
+    """
+
+    def __init__(self, scheduler_host: str, scheduler_port: int, pod_name: str,
+                 request: float, limit: float):
+        self.pod_name = pod_name
+        self.request = request
+        self.limit = limit
+        self._sched_addr = (scheduler_host, scheduler_port)
+        self._up = protocol.Connection(scheduler_host, scheduler_port)
+        self._up.call({"op": "register", "name": pod_name,
+                       "request": request, "limit": limit})
+        self._server: protocol.FramedServer | None = None
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> protocol.FramedServer:
+        self._server = protocol.serve_framed(host, port, self._handle,
+                                             self._cleanup)
+        log.info("pod manager for %s on %s:%d (request=%.2f limit=%.2f)",
+                 self.pod_name, host, self._server.server_address[1],
+                 self.request, self.limit)
+        return self._server
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def _handle(self, req: dict, state: dict) -> dict:
+        op = req.get("op")
+        if op == "register":
+            # The gate introduces itself; identity is fixed to this pod —
+            # a pod manager serves exactly its own pod (launcher.py:41-56).
+            return {"ok": True, "name": self.pod_name}
+        if op in ("acquire", "renew", "release", "usage"):
+            up = state.get("up")
+            if up is None:
+                up = protocol.Connection(*self._sched_addr)
+                up.call({"op": "attach", "name": self.pod_name})
+                state["up"] = up
+            reply, _ = up.call(dict(req, name=self.pod_name))
+            if op in ("acquire", "renew"):
+                state["holding"] = True
+                state["quota_ms"] = float(reply.get("quota_ms", 0.0))
+                state["grant_t"] = time.monotonic()
+            elif op == "release":
+                state["holding"] = False
+            return reply
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _cleanup(self, state: dict) -> None:
+        up = state.get("up")
+        if state.get("holding") and up is not None:
+            # The workload died holding the token. It can't report its
+            # usage, so charge the wall time since the grant, capped at the
+            # quota — conservative for limit enforcement (a crash-looping
+            # pod must not run rings around its tpu_limit by never
+            # reporting).
+            quota = state.get("quota_ms", 0.0)
+            elapsed = (time.monotonic() - state.get("grant_t", 0.0)) * 1000.0
+            used = min(max(elapsed, 0.0), quota)
+            try:
+                up.call({"op": "release", "name": self.pod_name,
+                         "used_ms": used})
+            except Exception:
+                pass
+        if up is not None:
+            up.close()
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        try:
+            self._up.call({"op": "unregister", "name": self.pod_name})
+        except Exception:
+            pass
+        self._up.close()
+
+
+def main(argv=None) -> None:
+    """CLI mirroring gem-pmgr's env contract (``launcher.py:41-56``)."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu.isolation.podmgr")
+    parser.add_argument("--scheduler-ip",
+                        default=os.environ.get("SCHEDULER_IP", "127.0.0.1"))
+    parser.add_argument("--scheduler-port", type=int,
+                        default=int(os.environ.get("SCHEDULER_PORT", "0")))
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get(ENV_POD_MANAGER_PORT, "0")))
+    parser.add_argument("--pod-name",
+                        default=os.environ.get(ENV_POD_NAME, ""))
+    parser.add_argument("--request", type=float,
+                        default=float(os.environ.get("POD_REQUEST", "0")))
+    parser.add_argument("--limit", type=float,
+                        default=float(os.environ.get("POD_LIMIT", "0")))
+    args = parser.parse_args(argv)
+
+    mgr = PodManager(args.scheduler_ip, args.scheduler_port, args.pod_name,
+                     args.request, args.limit)
+    server = mgr.serve(port=args.port)
+    print(f"READY {server.server_address[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    mgr.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,7 @@ byte blob after the JSON header, announced by ``_blob`` (its byte length).
 
 from __future__ import annotations
 
+import io
 import json
 import socket
 import socketserver
@@ -18,6 +19,19 @@ import threading
 
 _HDR = struct.Struct(">I")
 MAX_FRAME = 1 << 30
+
+
+def dump_array(arr) -> bytes:
+    """numpy array → .npy bytes (the blob format for device buffers)."""
+    import numpy as np
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def load_array(blob: bytes):
+    import numpy as np
+    return np.load(io.BytesIO(blob), allow_pickle=False)
 
 
 class ProtocolError(ConnectionError):
@@ -36,6 +50,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def send_msg(sock: socket.socket, msg: dict, blob: bytes | None = None) -> None:
     if blob is not None:
+        if len(blob) > MAX_FRAME:
+            raise ProtocolError(f"blob too large: {len(blob)}")
         msg = dict(msg, _blob=len(blob))
     data = json.dumps(msg).encode()
     if len(data) > MAX_FRAME:
@@ -50,7 +66,10 @@ def recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:
     msg = json.loads(_recv_exact(sock, size))
     blob = None
     if "_blob" in msg:
-        blob = _recv_exact(sock, int(msg.pop("_blob")))
+        blob_len = int(msg.pop("_blob"))
+        if not 0 <= blob_len <= MAX_FRAME:
+            raise ProtocolError(f"blob too large: {blob_len}")
+        blob = _recv_exact(sock, blob_len)
     return msg, blob
 
 
@@ -64,8 +83,15 @@ class Connection:
 
     def call(self, msg: dict, blob: bytes | None = None) -> tuple[dict, bytes | None]:
         with self._lock:
-            send_msg(self.sock, msg, blob)
-            reply, rblob = recv_msg(self.sock)
+            try:
+                send_msg(self.sock, msg, blob)
+                reply, rblob = recv_msg(self.sock)
+            except OSError:
+                # Fail-stop: a timeout or error mid-exchange leaves the
+                # stream desynced (the next recv would read this request's
+                # stale reply) — kill the channel rather than corrupt it.
+                self.close()
+                raise
         if not reply.get("ok", False):
             raise RuntimeError(reply.get("error", "remote error"))
         return reply, rblob

@@ -1,0 +1,411 @@
+"""The chip proxy: one process owns the chip, clients execute through it.
+
+On NVIDIA, N processes each own a CUDA context on one GPU, so the
+reference's isolation layer is an LD_PRELOAD metering shim inside each
+client (``libgemhook.so.1``, injected at ``pkg/scheduler/pod.go:445-457``).
+A TPU chip is single-tenant per process at the libtpu level, so interception
+becomes *proxying*: the :class:`ChipProxy` is the one resident process that
+holds the chip; client pods run JAX on the CPU backend, trace + serialize
+their programs with ``jax.export`` (StableHLO), and submit them over a local
+socket. Buffers stay device-resident between calls (PJRT's buffer model),
+so a training loop ships its parameters once and then exchanges only
+handles.
+
+Enforcement lives where the reference's lives:
+
+- **compute** — every execution is gated by the per-chip token scheduler
+  (:mod:`.tokensched`, gem-schd parity): a client acquires a quota, keeps
+  the token across back-to-back programs until the quota is exhausted
+  (Gemini's kernel-burst amortization), and an idle timer returns the token
+  early when the client stalls between steps;
+- **HBM** — device bytes are accounted per client at allocation time
+  (``put`` and execution outputs), mirroring the hook's ``gpu_mem`` cap at
+  ``cuMemAlloc`` (annotation default rule at ``pkg/scheduler/pod.go:419-424``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.logger import get_logger
+from . import protocol
+from .protocol import dump_array, load_array
+from .tokensched import TokenScheduler
+
+log = get_logger("proxy")
+
+IDLE_RELEASE_MS = 10.0
+
+
+def _now_ms() -> float:
+    return time.monotonic() * 1000.0
+
+
+@dataclass
+class _Executable:
+    exec_id: int
+    fn: object                    # jitted call on the proxy's backend
+    out_nbytes: int               # total output allocation, pre-checked
+    out_meta: list[tuple[list[int], str]]  # (shape, dtype) per output
+
+
+@dataclass
+class _Session:
+    name: str
+    request: float
+    limit: float
+    memory_cap: int               # bytes; 0 = uncapped
+    buffers: dict[int, object] = field(default_factory=dict)
+    executables: dict[int, _Executable] = field(default_factory=dict)
+    hbm_used: int = 0
+    next_id: int = 0
+    # token state (guarded by lock)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    holding: bool = False
+    busy: bool = False            # an execution is in flight right now
+    quota_ms: float = 0.0
+    used_ms: float = 0.0
+    last_end_ms: float = 0.0      # when the last execution finished
+    exec_count: int = 0
+    exec_ms_total: float = 0.0
+
+    def fresh_id(self) -> int:
+        self.next_id += 1
+        return self.next_id
+
+
+class HBMError(RuntimeError):
+    pass
+
+
+class ChipProxy:
+    """Owns one chip; serves the framed-JSON execution protocol.
+
+    ``device=None`` grabs the process's default JAX device — on a TPU host
+    that is the real chip; in tests it is a CPU device, which exercises the
+    identical code path (the proxy is backend-agnostic by construction).
+    """
+
+    def __init__(self, device=None, scheduler: TokenScheduler | None = None,
+                 idle_release_ms: float = IDLE_RELEASE_MS):
+        import jax
+        self._jax = jax
+        self.device = device if device is not None else jax.devices()[0]
+        self.platform = self.device.platform
+        self.scheduler = scheduler if scheduler is not None else TokenScheduler()
+        self.idle_release_ms = idle_release_ms
+        self._sessions: dict[str, _Session] = {}
+        self._slock = threading.Lock()
+        self._server: protocol.FramedServer | None = None
+        self._stop = threading.Event()
+        self._watchdog: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> protocol.FramedServer:
+        self._server = protocol.serve_framed(host, port, self._handle, self._cleanup)
+        self._watchdog = threading.Thread(target=self._watch_idle, daemon=True,
+                                          name="proxy-idle-watchdog")
+        self._watchdog.start()
+        log.info("chip proxy serving %s on %s:%d", self.device,
+                 *self._server.server_address[:2])
+        return self._server
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        with self._slock:
+            names = list(self._sessions)
+        for name in names:
+            self._drop_session(name)
+        self.scheduler.close()
+
+    # -- session management --------------------------------------------------
+
+    def _register(self, name: str, request: float, limit: float,
+                  memory: int) -> _Session:
+        with self._slock:
+            if name in self._sessions:
+                raise ValueError(f"duplicate client {name}")
+            self.scheduler.add_client(name, request, limit)
+            sess = _Session(name, request, limit, memory)
+            self._sessions[name] = sess
+            return sess
+
+    def _session(self, name: str) -> _Session:
+        with self._slock:
+            try:
+                return self._sessions[name]
+            except KeyError:
+                raise KeyError(f"unknown client {name!r}") from None
+
+    def _drop_session(self, name: str) -> None:
+        with self._slock:
+            sess = self._sessions.pop(name, None)
+        if sess is None:
+            return
+        with sess.lock:
+            holding, used = sess.holding, sess.used_ms
+            sess.holding = False
+        if holding:
+            try:
+                self.scheduler.release(name, used)
+            except Exception:
+                pass
+        self.scheduler.remove_client(name)
+        sess.buffers.clear()
+        sess.executables.clear()
+        log.info("client %s dropped (freed %d bytes HBM)", name, sess.hbm_used)
+
+    # -- HBM accounting ------------------------------------------------------
+
+    def _charge(self, sess: _Session, nbytes: int) -> None:
+        if sess.memory_cap and sess.hbm_used + nbytes > sess.memory_cap:
+            raise HBMError(
+                f"{sess.name}: HBM cap exceeded "
+                f"({sess.hbm_used} + {nbytes} > {sess.memory_cap})")
+        sess.hbm_used += nbytes
+
+    # -- token gate ----------------------------------------------------------
+
+    def _gated(self, sess: _Session, fn):
+        """Run ``fn()`` under the chip token (Gemini burst semantics).
+
+        On quota exhaustion the token is *renewed* — an atomic
+        release + re-request in the scheduler — rather than released and
+        re-acquired: a release-then-acquire pair would hand the freed token
+        to whichever other client happened to be waiting in the gap,
+        collapsing request-weighted shares to round-robin (the same hazard
+        ``TokenScheduler.renew`` documents). Idle clients return the token
+        via the idle timer instead.
+        """
+        with sess.lock:
+            sess.busy = True
+            holding = sess.holding
+            exhausted = holding and sess.used_ms >= sess.quota_ms
+            used = sess.used_ms
+        try:
+            if not holding:
+                quota = self.scheduler.acquire(sess.name)
+            elif exhausted:
+                quota = self.scheduler.renew(sess.name, used)
+            else:
+                quota = None
+            if quota is not None:
+                with sess.lock:
+                    sess.holding = True
+                    sess.quota_ms = quota
+                    sess.used_ms = 0.0
+            start = _now_ms()
+            try:
+                result = fn()
+            finally:
+                elapsed = _now_ms() - start
+                with sess.lock:
+                    sess.used_ms += elapsed
+                    sess.exec_count += 1
+                    sess.exec_ms_total += elapsed
+            return result
+        finally:
+            with sess.lock:
+                sess.busy = False
+                sess.last_end_ms = _now_ms()
+
+    def _watch_idle(self) -> None:
+        """Return tokens from clients that stopped executing (one watchdog
+        thread for the whole proxy — not a timer per step)."""
+        period = max(self.idle_release_ms / 2.0, 1.0) / 1000.0
+        while not self._stop.wait(period):
+            now = _now_ms()
+            with self._slock:
+                sessions = list(self._sessions.values())
+            for sess in sessions:
+                with sess.lock:
+                    idle = (sess.holding and not sess.busy
+                            and now - sess.last_end_ms >= self.idle_release_ms)
+                    if idle:
+                        sess.holding = False
+                        used = sess.used_ms
+                if idle:
+                    try:
+                        self.scheduler.release(sess.name, used)
+                    except Exception:  # raced a drop
+                        pass
+
+    # -- protocol ------------------------------------------------------------
+
+    def _handle(self, req: dict, state: dict) -> dict:
+        op = req.get("op")
+        if op == "register":
+            if state.get("name"):
+                # A second register would orphan the first session at
+                # disconnect (cleanup drops only state["name"]).
+                raise ValueError(
+                    f"connection already registered as {state['name']!r}")
+            name = req["name"]
+            self._register(name, float(req["request"]), float(req["limit"]),
+                           int(req.get("memory", 0)))
+            state["name"] = name
+            return {"ok": True, "platforms": [self.platform],
+                    "device": str(self.device)}
+
+        # Identity is connection-bound: a session is only reachable from the
+        # connection that registered it (a client must not be able to burn
+        # another client's quota or free its buffers by naming it).
+        name = state.get("name")
+        if not name:
+            raise PermissionError("not registered on this connection")
+        sess = self._session(name)
+
+        if op == "put":
+            arr = load_array(state["blob"])
+            # Pre-check with the host-side size so an over-cap upload is
+            # refused before touching the device at all...
+            self._charge(sess, arr.nbytes)
+            sess.hbm_used -= arr.nbytes
+            buf = self._jax.device_put(arr, self.device)
+            try:
+                # ...then account the *device* buffer: device_put
+                # canonicalizes dtypes (e.g. int64→int32 with x64 off), so
+                # charging the host size would leak on every put/free cycle.
+                self._charge(sess, int(buf.nbytes))
+            except HBMError:
+                del buf
+                raise
+            handle = sess.fresh_id()
+            sess.buffers[handle] = buf
+            return {"ok": True, "handle": handle,
+                    "shape": list(buf.shape), "dtype": str(buf.dtype)}
+
+        if op == "get":
+            buf = sess.buffers[int(req["handle"])]
+            if int(buf.nbytes) > protocol.MAX_FRAME - 4096:
+                # An over-frame reply would raise in the server's *send*
+                # path, tearing down the connection — and with it the whole
+                # session's buffers. Refuse here so the client gets an
+                # error reply and keeps its state.
+                raise ValueError(
+                    f"buffer too large to transfer ({int(buf.nbytes)} bytes);"
+                    " fetch it in slices")
+            state["reply_blob"] = dump_array(buf)
+            return {"ok": True}
+
+        if op == "free":
+            for handle in req["handles"]:
+                buf = sess.buffers.pop(int(handle), None)
+                if buf is not None:
+                    sess.hbm_used -= int(buf.nbytes)
+            return {"ok": True}
+
+        if op == "compile":
+            return self._compile(sess, state["blob"])
+
+        if op == "execute":
+            return self._execute(sess, req)
+
+        if op == "usage":
+            return {"ok": True,
+                    "used_ms": self.scheduler.window_usage(sess.name),
+                    "window_ms": self.scheduler.window_ms,
+                    "hbm_used": sess.hbm_used,
+                    "exec_count": sess.exec_count,
+                    "exec_ms_total": sess.exec_ms_total}
+
+        if op == "unregister":
+            self._drop_session(sess.name)
+            state.pop("name", None)
+            return {"ok": True}
+
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _compile(self, sess: _Session, blob: bytes) -> dict:
+        from jax import export
+        exported = export.deserialize(blob)
+        out_meta = [(list(a.shape), str(a.dtype)) for a in exported.out_avals]
+        out_nbytes = sum(
+            int(np.prod(shape or [1])) * np.dtype(dtype).itemsize
+            for shape, dtype in out_meta)
+        fn = self._jax.jit(exported.call)
+        exec_id = sess.fresh_id()
+        sess.executables[exec_id] = _Executable(exec_id, fn, out_nbytes, out_meta)
+        return {"ok": True, "exec_id": exec_id,
+                "out_meta": out_meta, "out_nbytes": out_nbytes}
+
+    def _execute(self, sess: _Session, req: dict) -> dict:
+        exe = sess.executables[int(req["exec_id"])]
+        args = [sess.buffers[int(h)] for h in req["args"]]
+        donate = [int(h) for h in req.get("donate", [])]
+        # Cap check up front — allocation must not happen over-cap even
+        # transiently (donated buffers are freed only after success).
+        self._charge(sess, exe.out_nbytes)
+        try:
+            outs = self._gated(sess, lambda: self._run(exe, args))
+        except Exception:
+            sess.hbm_used -= exe.out_nbytes
+            raise
+        handles = []
+        for out in outs:
+            handle = sess.fresh_id()
+            sess.buffers[handle] = out
+            handles.append(handle)
+        for handle in donate:
+            buf = sess.buffers.pop(handle, None)
+            if buf is not None:
+                sess.hbm_used -= int(buf.nbytes)
+        return {"ok": True, "handles": handles}
+
+    def _run(self, exe: _Executable, args: list):
+        outs = exe.fn(*args)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        self._jax.block_until_ready(outs)
+        return list(outs)
+
+    def _cleanup(self, state: dict) -> None:
+        name = state.get("name")
+        if name:
+            self._drop_session(name)
+
+
+def main(argv=None) -> None:
+    """``python -m kubeshare_tpu.isolation.proxy -P 49901 ...`` — the
+    gem-schd launch shape (``launcher.py:22-32``), owning the chip too."""
+    import argparse
+    import signal
+
+    from ..constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
+
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu.isolation.proxy")
+    parser.add_argument("-P", "--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("-q", "--base-quota", type=float, default=BASE_QUOTA_MS)
+    parser.add_argument("-m", "--min-quota", type=float, default=MIN_QUOTA_MS)
+    parser.add_argument("-w", "--window", type=float, default=WINDOW_MS)
+    args = parser.parse_args(argv)
+
+    sched = TokenScheduler(window_ms=args.window, base_quota_ms=args.base_quota,
+                           min_quota_ms=args.min_quota)
+    proxy = ChipProxy(scheduler=sched)
+    server = proxy.serve(args.host, args.port)
+    print(f"READY {server.server_address[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    proxy.close()
+
+
+if __name__ == "__main__":
+    main()

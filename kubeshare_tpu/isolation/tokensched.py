@@ -273,6 +273,7 @@ class TokenScheduler:
         self._core = make_core(window_ms, base_quota_ms, min_quota_ms, native)
         self._cond = threading.Condition()
         self._grants: dict[str, float] = {}  # name -> granted quota_ms
+        self._waiting: set[str] = set()      # names with a blocked waiter
         self._clock = clock or _now_ms
         self.window_ms = window_ms
 
@@ -297,6 +298,14 @@ class TokenScheduler:
             self._core.request_token(name)
             return self._wait_for_grant(name, deadline)
 
+    def _enter_wait(self, name: str) -> None:
+        # A client is one token stream: a second concurrent waiter for the
+        # same name would race the single grant slot (one pops it, the
+        # other re-waits with no pending request — forever). Fail fast.
+        if name in self._waiting:
+            raise RuntimeError(f"{name}: token request already in flight")
+        self._waiting.add(name)
+
     def renew(self, name: str, used_ms: float, timeout: float | None = None) -> float:
         """Atomically release + re-request + wait for the next grant.
 
@@ -316,32 +325,44 @@ class TokenScheduler:
             return self._wait_for_grant(name, deadline)
 
     def _wait_for_grant(self, name: str, deadline: float | None) -> float:
-        # Caller holds self._cond.
-        while True:
-            result = self._core.poll(self._clock())
-            if isinstance(result, tuple):
-                granted, quota = result
-                self._grants[granted] = quota
-                self._cond.notify_all()
-            if name in self._grants:
-                return self._grants.pop(name)
-            wait: float | None
-            if isinstance(result, tuple) or result == _INF:
-                wait = None
-            else:
-                wait = max(0.001, (result - self._clock()) / 1000.0)
-            if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    # Withdraw cleanly: consume-and-return a grant that
-                    # raced in, else clear the waiting flag so the core
-                    # never hands out a token nobody will consume.
-                    if name in self._grants:
-                        return self._grants.pop(name)
-                    self._core.cancel_request(name)
-                    raise TimeoutError(f"{name}: token wait timed out")
-                wait = remaining if wait is None else min(wait, remaining)
-            self._cond.wait(wait)
+        # Caller holds self._cond and has already requested the token.
+        self._enter_wait(name)
+        try:
+            while True:
+                result = self._core.poll(self._clock())
+                if isinstance(result, tuple):
+                    granted, quota = result
+                    self._grants[granted] = quota
+                    self._cond.notify_all()
+                if name in self._grants:
+                    return self._grants.pop(name)
+                try:
+                    self._core.window_usage(name, self._clock())
+                except KeyError:
+                    # Client was removed while we waited (owner connection
+                    # died / unregister): error out instead of blocking on
+                    # a grant that can never come.
+                    raise RuntimeError(f"{name}: client removed while "
+                                       "waiting for token") from None
+                wait: float | None
+                if isinstance(result, tuple) or result == _INF:
+                    wait = None
+                else:
+                    wait = max(0.001, (result - self._clock()) / 1000.0)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        # Withdraw cleanly: consume-and-return a grant that
+                        # raced in, else clear the waiting flag so the core
+                        # never hands out a token nobody will consume.
+                        if name in self._grants:
+                            return self._grants.pop(name)
+                        self._core.cancel_request(name)
+                        raise TimeoutError(f"{name}: token wait timed out")
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+        finally:
+            self._waiting.discard(name)
 
     def release(self, name: str, used_ms: float) -> None:
         with self._cond:
@@ -360,23 +381,45 @@ class TokenScheduler:
 def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
     """Expose a :class:`TokenScheduler` over framed-JSON TCP.
 
-    Requests: ``{"op": "register", "name", "request", "limit"}``,
-    ``{"op": "acquire", "name"}`` (blocks; reply carries ``quota_ms``),
-    ``{"op": "renew", "name", "used_ms"}`` (atomic release+reacquire — the
-    steady-state call), ``{"op": "release", "name", "used_ms"}``,
-    ``{"op": "usage", "name"}``,
-    ``{"op": "unregister", "name"}``. Replies: ``{"ok": true, ...}`` or
-    ``{"ok": false, "error": msg}``. One connection per pod manager; the
-    server cleans up the client on disconnect (≙ gem-schd dropping a dead
-    pod manager).
+    Requests: ``{"op": "register", "name", "request", "limit"}`` (creates
+    the client; this connection owns it), ``{"op": "attach", "name"}``
+    (binds an extra connection to an existing client — a pod manager's
+    per-gate relay channels), ``{"op": "acquire"}`` (blocks; reply carries
+    ``quota_ms``), ``{"op": "renew", "used_ms"}`` (atomic
+    release+reacquire — the steady-state call), ``{"op": "release",
+    "used_ms"}``, ``{"op": "usage"}``, ``{"op": "unregister"}``.
+    Token ops act on the *connection-bound* identity (set by
+    register/attach) — a connection can never name another pod's client.
+    Replies: ``{"ok": true, ...}`` or ``{"ok": false, "error": msg}``.
+    The owning connection's disconnect removes the client (≙ gem-schd
+    dropping a dead pod manager); attached connections' disconnects don't.
     """
     def handle(req: dict, state: dict) -> dict:
         op = req.get("op")
-        name = req.get("name", "")
+        if op not in ("register", "attach", "acquire", "renew", "release",
+                      "usage", "unregister"):
+            return {"ok": False, "error": f"unknown op {op!r}"}
         if op == "register":
+            if state.get("name"):
+                raise ValueError(
+                    f"connection already bound to {state['name']!r}")
+            name = req["name"]
             scheduler.add_client(name, float(req["request"]), float(req["limit"]))
             state["name"] = name
+            state["owner"] = True
             return {"ok": True}
+        if op == "attach":
+            if state.get("name"):
+                raise ValueError(
+                    f"connection already bound to {state['name']!r}")
+            name = req["name"]
+            scheduler.window_usage(name)  # KeyError if no such client
+            state["name"] = name
+            state["owner"] = False
+            return {"ok": True}
+        name = state.get("name")
+        if not name:
+            raise PermissionError("connection not bound (register/attach first)")
         if op == "acquire":
             quota = scheduler.acquire(name, timeout=req.get("timeout"))
             return {"ok": True, "quota_ms": quota}
@@ -394,12 +437,11 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
         if op == "unregister":
             scheduler.remove_client(name)
             state.pop("name", None)
-            return {"ok": True}
-        return {"ok": False, "error": f"unknown op {op!r}"}
+            state.pop("owner", None)
+        return {"ok": True}
 
     def cleanup(state: dict) -> None:
-        name = state.get("name")
-        if name:
-            scheduler.remove_client(name)
+        if state.get("owner") and state.get("name"):
+            scheduler.remove_client(state["name"])
 
     return protocol.serve_framed(host, port, handle, cleanup)

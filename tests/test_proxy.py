@@ -1,0 +1,370 @@
+"""Chip-proxy + client + pod-manager integration tests.
+
+The proxy runs on the CPU backend here — the identical code path serves the
+real chip (the proxy is backend-agnostic; ``bench.py`` is the on-hardware
+proof). These are the tests the reference never had for its Gemini stack
+(SURVEY §4: the de-facto integration test was a manual harness).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeshare_tpu.isolation import protocol
+from kubeshare_tpu.isolation.client import ExecutionGate, ProxyClient
+from kubeshare_tpu.isolation.podmgr import PodManager
+from kubeshare_tpu.isolation.proxy import ChipProxy
+from kubeshare_tpu.isolation.tokensched import TokenScheduler, serve
+
+WINDOW = 1000.0
+BASE = 100.0
+MIN = 10.0
+
+
+@pytest.fixture
+def proxy():
+    p = ChipProxy(scheduler=TokenScheduler(WINDOW, BASE, MIN))
+    p.serve()
+    yield p
+    p.close()
+
+
+def connect(proxy, name, request=0.5, limit=1.0, memory=0):
+    return ProxyClient("127.0.0.1", proxy.port, name, request, limit,
+                       memory=memory)
+
+
+def test_put_get_free_roundtrip(proxy):
+    with connect(proxy, "c") as c:
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf = c.put(arr)
+        assert buf.shape == (3, 4) and buf.dtype == "float32"
+        np.testing.assert_array_equal(c.get(buf), arr)
+        assert c.usage()["hbm_used"] == arr.nbytes
+        c.free(buf)
+        assert c.usage()["hbm_used"] == 0
+
+
+def test_hbm_cap_enforced_at_put(proxy):
+    with connect(proxy, "c", memory=100) as c:
+        c.put(np.zeros(20, np.float32))  # 80 bytes
+        with pytest.raises(RuntimeError, match="HBM cap"):
+            c.put(np.zeros(20, np.float32))  # would be 160
+
+
+def test_compile_execute_device_resident(proxy):
+    with connect(proxy, "c") as c:
+        x = np.ones((4, 4), np.float32)
+        exe = c.compile(lambda a, b: {"y": a @ b, "s": jnp.sum(a)}, x, x)
+        bx = c.put(x)
+        out = exe(bx, bx)
+        assert set(out) == {"y", "s"}
+        np.testing.assert_allclose(c.get(out["y"]), x @ x)
+        assert float(c.get(out["s"])) == 16.0
+        # outputs are device-resident: feed them back without download
+        out2 = exe(out["y"], bx)
+        np.testing.assert_allclose(c.get(out2["y"]), (x @ x) @ x)
+
+
+def test_execute_charges_and_donate_frees(proxy):
+    with connect(proxy, "c") as c:
+        x = np.ones((8, 8), np.float32)
+        bx = c.put(x)
+        base = c.usage()["hbm_used"]
+        exe = c.compile(lambda a: a * 2.0, bx)
+        out = exe(bx)
+        assert c.usage()["hbm_used"] == base + x.nbytes
+        out2 = exe(out, donate=True)  # frees `out` after success
+        assert c.usage()["hbm_used"] == base + x.nbytes
+        np.testing.assert_allclose(c.get(out2), x * 4.0)
+
+
+def test_hbm_cap_enforced_at_execute(proxy):
+    x = np.zeros((16, 16), np.float32)  # 1024 bytes
+    with connect(proxy, "c", memory=1600) as c:
+        bx = c.put(x)
+        exe = c.compile(lambda a: a + 1.0, bx)
+        with pytest.raises(RuntimeError, match="HBM cap"):
+            exe(bx)  # output another 1024 > cap
+        # failed execute must not leak the pre-charge
+        assert c.usage()["hbm_used"] == x.nbytes
+
+
+def test_training_loop_through_proxy(proxy):
+    """A linear-regression loop entirely through the proxy converges."""
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(4,)).astype(np.float32)
+    xs = rng.normal(size=(64, 4)).astype(np.float32)
+    ys = xs @ w_true
+
+    def step(w, xb, yb):
+        def loss(w):
+            return jnp.mean((xb @ w - yb) ** 2)
+        l, g = jax.value_and_grad(loss)(w)
+        return w - 0.1 * g, l
+
+    with connect(proxy, "trainer") as c:
+        w = c.put(np.zeros(4, np.float32))
+        bx, by = c.put(xs), c.put(ys)
+        exe = c.compile(step, w, bx, by)
+        for _ in range(60):
+            w, l = exe(w, bx, by)
+        assert float(c.get(l)) < 1e-3
+        np.testing.assert_allclose(c.get(w), w_true, atol=1e-2)
+        u = c.usage()
+        assert u["exec_count"] == 60
+        assert u["exec_ms_total"] > 0
+
+
+def test_session_is_connection_bound(proxy):
+    """A connection can only act on the session it registered (no quota /
+    buffer theft by naming another client)."""
+    with connect(proxy, "victim") as victim:
+        bv = victim.put(np.zeros(10, np.float32))
+        with protocol.Connection("127.0.0.1", proxy.port) as rogue:
+            with pytest.raises(RuntimeError, match="not registered"):
+                rogue.call({"op": "free", "name": "victim",
+                            "handles": [bv.handle]})
+        assert victim.usage()["hbm_used"] == 40
+
+
+def test_host_uploads_freed_per_call(proxy):
+    """Host-array args auto-uploaded by a call don't accumulate on the
+    proxy."""
+    x = np.ones((8, 8), np.float32)
+    with connect(proxy, "c") as c:
+        exe = c.compile(lambda a, b: a + b, x, x)
+        bx = c.put(x)
+        out1 = exe(bx, x)   # b uploaded per call
+        used1 = c.usage()["hbm_used"]
+        out2 = exe(bx, x)
+        used2 = c.usage()["hbm_used"]
+        assert used2 - used1 == x.nbytes  # only out2 remains, not the upload
+        np.testing.assert_allclose(c.get(out2), 2 * x)
+        c.free(out1, out2)
+
+
+def test_disconnect_frees_session(proxy):
+    c = connect(proxy, "gone")
+    c.put(np.zeros(10, np.float32))
+    c._conn.close()  # hard drop, no unregister
+    deadline = time.monotonic() + 2.0
+    while proxy.scheduler.core.client_count() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert proxy.scheduler.core.client_count() == 0
+    # name is reusable after cleanup
+    with connect(proxy, "gone") as c2:
+        assert c2.usage()["hbm_used"] == 0
+
+
+def _greedy_client(proxy, name, request, stop, used_out, nloops=20):
+    with connect(proxy, name, request=request, limit=1.0) as c:
+        x = np.ones((192, 192), np.float32)
+        bx = c.put(x)
+
+        def burn(a):
+            def body(_, acc):
+                return acc @ a / 192.0
+            return jax.lax.fori_loop(0, nloops, body, a)
+
+        exe = c.compile(burn, bx)
+        while not stop.is_set():
+            bx = exe(bx, donate=True)
+        used_out[name] = c.usage()["exec_ms_total"]
+
+
+def test_colocated_shares_follow_requests(proxy):
+    """Two greedy clients at 0.75/0.25 → device-time shares ≈ 3:1."""
+    stop = threading.Event()
+    used: dict = {}
+    threads = [
+        threading.Thread(target=_greedy_client,
+                         args=(proxy, "big", 0.75, stop, used)),
+        threading.Thread(target=_greedy_client,
+                         args=(proxy, "small", 0.25, stop, used)),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(2.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=15.0)
+    share = used["big"] / (used["big"] + used["small"])
+    assert 0.6 <= share <= 0.9, used
+
+
+def test_limit_cap_holds_solo_client(proxy):
+    """A lone limit=0.3 client gets ≤ ~30% of wall time on the chip."""
+    stop = threading.Event()
+    used: dict = {}
+
+    def run():
+        with connect(proxy, "capped", request=0.3, limit=0.3) as c:
+            x = np.ones((192, 192), np.float32)
+            bx = c.put(x)
+
+            def burn(a):
+                def body(_, acc):
+                    return acc @ a / 192.0
+                return jax.lax.fori_loop(0, 20, body, a)
+
+            exe = c.compile(burn, bx)
+            while not stop.is_set():
+                bx = exe(bx, donate=True)
+            used["ms"] = c.usage()["exec_ms_total"]
+
+    t = threading.Thread(target=run)
+    t.start()
+    start = time.monotonic()
+    time.sleep(2.5)
+    stop.set()
+    t.join(timeout=20.0)
+    elapsed_ms = (time.monotonic() - start) * 1000.0
+    assert used["ms"] / elapsed_ms <= 0.40, used
+
+
+# --------------------------------------------------------------------------
+# Pod manager + gate
+# --------------------------------------------------------------------------
+
+def test_podmanager_relays_and_unregisters():
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    schd_server = serve(sched)
+    mgr = PodManager("127.0.0.1", schd_server.server_address[1],
+                     "ns/pod-a", 0.5, 1.0)
+    mgr.serve()
+    try:
+        assert sched.core.client_count() == 1
+        with protocol.Connection("127.0.0.1", mgr.port) as conn:
+            reply, _ = conn.call({"op": "register", "name": "ignored"})
+            assert reply["name"] == "ns/pod-a"
+            reply, _ = conn.call({"op": "acquire", "name": "x"})
+            assert reply["quota_ms"] == BASE
+            conn.call({"op": "release", "name": "x", "used_ms": 30.0})
+            reply, _ = conn.call({"op": "usage", "name": "x"})
+            assert reply["used_ms"] == pytest.approx(30.0, abs=5.0)
+    finally:
+        mgr.close()
+        assert sched.core.client_count() == 0
+        schd_server.shutdown()
+
+
+def test_execution_gate_accounts_usage():
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    schd_server = serve(sched)
+    mgr = PodManager("127.0.0.1", schd_server.server_address[1],
+                     "ns/pod-g", 0.5, 1.0)
+    mgr.serve()
+    try:
+        conn = protocol.Connection("127.0.0.1", mgr.port)
+        conn.call({"op": "register"})
+        gate = ExecutionGate(conn, "ns/pod-g")
+        for _ in range(5):
+            gate()                 # token round-trip before the "step"
+            time.sleep(0.03)       # 30ms of simulated device time
+        gate.close()
+        usage = sched.window_usage("ns/pod-g")
+        assert usage == pytest.approx(150.0, rel=0.5)
+        conn.close()
+    finally:
+        mgr.close()
+        schd_server.shutdown()
+
+
+def test_gate_crash_releases_token():
+    """A workload that dies while holding the token must not starve the
+    chip: the pod manager releases on gate disconnect."""
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    schd_server = serve(sched)
+    mgr = PodManager("127.0.0.1", schd_server.server_address[1],
+                     "ns/crasher", 0.5, 1.0)
+    mgr.serve()
+    try:
+        conn = protocol.Connection("127.0.0.1", mgr.port)
+        reply, _ = conn.call({"op": "acquire", "name": "x"})
+        assert reply["quota_ms"] == BASE
+        assert sched.core.holder() == "ns/crasher"
+        conn.close()  # crash: no release
+        deadline = time.monotonic() + 2.0
+        while sched.core.holder() is not None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.core.holder() is None
+    finally:
+        mgr.close()
+        schd_server.shutdown()
+
+
+def test_two_gate_connections_no_deadlock():
+    """Two connections to one pod manager (e.g. a usage-polling sidecar)
+    must not wedge the relay while an acquire blocks."""
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    schd_server = serve(sched)
+    mgr = PodManager("127.0.0.1", schd_server.server_address[1],
+                     "ns/pod-m", 0.5, 1.0)
+    mgr.serve()
+    try:
+        c1 = protocol.Connection("127.0.0.1", mgr.port)
+        c2 = protocol.Connection("127.0.0.1", mgr.port)
+        c1.call({"op": "acquire"})  # pod holds the token
+        # second connection can still talk to the scheduler concurrently
+        reply, _ = c2.call({"op": "usage"})
+        assert reply["window_ms"] == WINDOW
+        c1.call({"op": "release", "used_ms": 10.0})
+        c1.close()
+        c2.close()
+    finally:
+        mgr.close()
+        schd_server.shutdown()
+
+
+def test_schd_server_identity_is_connection_bound():
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    schd_server = serve(sched)
+    try:
+        owner = protocol.Connection("127.0.0.1", schd_server.server_address[1])
+        owner.call({"op": "register", "name": "p", "request": 0.5, "limit": 1.0})
+        rogue = protocol.Connection("127.0.0.1", schd_server.server_address[1])
+        with pytest.raises(RuntimeError, match="not bound"):
+            rogue.call({"op": "release", "name": "p", "used_ms": 5.0})
+        with pytest.raises(RuntimeError, match="KeyError"):
+            rogue.call({"op": "attach", "name": "nope"})
+        with pytest.raises(RuntimeError, match="already bound"):
+            owner.call({"op": "register", "name": "q",
+                        "request": 0.5, "limit": 1.0})
+        # attach binds to the existing client without creating/owning it
+        rogue.call({"op": "attach", "name": "p"})
+        reply, _ = rogue.call({"op": "usage"})
+        assert reply["window_ms"] == WINDOW
+        rogue.close()
+        time.sleep(0.1)
+        assert sched.core.client_count() == 1  # attach drop ≠ unregister
+        owner.close()
+        rogue = None
+        deadline = time.monotonic() + 2.0
+        while sched.core.client_count() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.core.client_count() == 0
+    finally:
+        schd_server.shutdown()
+
+
+def test_gate_renews_when_quota_exhausted():
+    sched = TokenScheduler(WINDOW, base_quota_ms=50.0, min_quota_ms=5.0)
+    schd_server = serve(sched)
+    try:
+        conn = protocol.Connection("127.0.0.1", schd_server.server_address[1])
+        conn.call({"op": "register", "name": "g", "request": 0.9, "limit": 1.0})
+        gate = ExecutionGate(conn, "g")
+        for _ in range(4):
+            gate()
+            time.sleep(0.03)  # 30ms steps vs 50ms quota → renew mid-loop
+        gate.close()
+        assert sched.window_usage("g") == pytest.approx(120.0, rel=0.5)
+        conn.close()
+    finally:
+        schd_server.shutdown()

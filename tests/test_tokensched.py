@@ -252,6 +252,57 @@ def test_renew_preserves_stride_shares():
     assert 0.62 <= share <= 0.78, share
 
 
+def test_concurrent_waiters_same_name_rejected():
+    """One client = one token stream: a second in-flight request for the
+    same name would race the single grant slot; it must fail fast."""
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    sched.add_client("a", 0.5, 1.0)
+    sched.add_client("b", 0.5, 1.0)
+    sched.acquire("a")  # a holds the token; b's waiters will block
+    errs: list[Exception] = []
+    started = threading.Event()
+
+    def waiter():
+        started.set()
+        try:
+            sched.acquire("b", timeout=2.0)
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    started.wait()
+    time.sleep(0.05)  # let the first waiter enter the wait
+    with pytest.raises(RuntimeError, match="already in flight"):
+        sched.acquire("b", timeout=0.5)
+    sched.release("a", 1.0)
+    t.join(timeout=5.0)
+    assert not errs, errs
+
+
+def test_waiter_errors_when_client_removed():
+    """A blocked waiter whose client is removed must error, not hang."""
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    sched.add_client("a", 0.5, 1.0)
+    sched.add_client("b", 0.5, 1.0)
+    sched.acquire("a")  # b will block behind a
+    errs: list[Exception] = []
+
+    def waiter():
+        try:
+            sched.acquire("b")  # no timeout: must still be woken
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    sched.remove_client("b")
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "waiter hung after client removal"
+    assert errs and "removed" in str(errs[0])
+
+
 def test_facade_acquire_timeout_cancels():
     sched = TokenScheduler(WINDOW, BASE, MIN)
     sched.add_client("a", 0.5, 1.0)
