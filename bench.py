@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""North-star benchmark: fractional sharing overhead on one chip.
+
+Measures the target stated in BASELINE.md (derived from the reference's
+eval workloads, ``test/mnist/mnist1.yaml:15``):
+
+1. **exclusive** — the mnist train step run directly on the chip
+   (isolated baseline, no framework in the path);
+2. **co-located** — two clients, each ``tpu_request=0.5``, running the
+   same training loop concurrently *through* the isolation runtime
+   (:class:`~kubeshare_tpu.isolation.proxy.ChipProxy` + token scheduler
+   with Gemini-parity quota/window, ``launcher.py:75-80``).
+
+Prints ONE JSON line::
+
+    {"metric": "colocated_2x0.5_aggregate_ratio", "value": <aggregate
+     co-located steps/s ÷ exclusive steps/s>, "unit": "fraction",
+     "vs_baseline": <value ÷ 0.90 target>, ...detail keys...}
+
+North star: value ≥ 0.90 and per-client device-time share within 5% of
+the 0.5 request.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _exclusive_steps_per_sec(duration: float) -> float:
+    """Isolated baseline: timed steps directly on the default device."""
+    import jax
+    import optax
+
+    from kubeshare_tpu.models import mnist
+    from kubeshare_tpu.models.common import make_train_step
+
+    key = jax.random.PRNGKey(0)
+    pkey, bkey = jax.random.split(key)
+    params = mnist.init(pkey)
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    step = make_train_step(mnist.loss_fn, optimizer)
+    batch = mnist.batch_fn(bkey)
+
+    for _ in range(3):  # absorb compile
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    steps = 0
+    start = time.perf_counter()
+    deadline = start + duration
+    while time.perf_counter() < deadline:
+        params, opt_state, loss = step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+        steps += 1
+    return steps / (time.perf_counter() - start)
+
+
+def _proxied_trainer(proxy_port: int, name: str, request: float, limit: float,
+                     barrier: threading.Barrier, duration: float,
+                     chunk: int, results: dict) -> None:
+    """One co-located client: mnist training through the proxy's fused-loop
+    path (``chunk`` steps per dispatch = one token-gated XLA burst)."""
+    import jax
+    import optax
+
+    from kubeshare_tpu.isolation.client import ProxyClient
+    from kubeshare_tpu.models import mnist
+
+    optimizer = optax.adam(1e-3)
+
+    def train_chunk(carry, batch):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(mnist.loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    key = jax.random.PRNGKey(hash(name) % (1 << 31))
+    pkey, bkey = jax.random.split(key)
+    host_params = mnist.init(pkey)
+    host_opt = optimizer.init(host_params)
+    host_batch = mnist.batch_fn(bkey)
+
+    with ProxyClient("127.0.0.1", proxy_port, name, request, limit) as c:
+        carry = (c.put_tree(jax.tree_util.tree_map(np.asarray, host_params)),
+                 c.put_tree(jax.tree_util.tree_map(np.asarray, host_opt)))
+        batch = c.put_tree(tuple(np.asarray(b) for b in host_batch))
+        loop = c.compile_loop(train_chunk, carry, batch)
+
+        carry, loss = loop(chunk, carry, batch)  # absorb the proxy compile
+        c.free(loss)
+
+        used0 = c.usage()["exec_ms_total"]
+        barrier.wait()
+        steps = 0
+        start = time.perf_counter()
+        deadline = start + duration
+        while time.perf_counter() < deadline:
+            carry, loss = loop(chunk, carry, batch)
+            c.free(loss)
+            steps += loop.last_n  # proxy may clamp a burst to its quantum
+        elapsed = time.perf_counter() - start
+        results[name] = {
+            "steps": steps,
+            "steps_per_sec": steps / elapsed,
+            "exec_ms": c.usage()["exec_ms_total"] - used0,
+        }
+
+
+def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100) -> dict:
+    from kubeshare_tpu.isolation.proxy import ChipProxy
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    exclusive_sps = _exclusive_steps_per_sec(exclusive_s)
+
+    proxy = ChipProxy(scheduler=TokenScheduler())
+    proxy.serve()
+    try:
+        barrier = threading.Barrier(2)
+        results: dict = {}
+        threads = [
+            threading.Thread(
+                target=_proxied_trainer,
+                args=(proxy.port, name, 0.5, 1.0, barrier, colocated_s,
+                      chunk, results),
+                name=f"bench-{name}")
+            for name in ("client-a", "client-b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        proxy.close()
+
+    if len(results) != 2:
+        raise RuntimeError(f"co-located clients failed: {sorted(results)}")
+
+    a, b = (results[n] for n in ("client-a", "client-b"))
+    aggregate_sps = a["steps_per_sec"] + b["steps_per_sec"]
+    ratio = aggregate_sps / exclusive_sps if exclusive_sps else 0.0
+    total_exec = a["exec_ms"] + b["exec_ms"]
+    share_a = a["exec_ms"] / total_exec if total_exec else 0.0
+    share_error_pct = abs(share_a - 0.5) / 0.5 * 100.0
+
+    return {
+        "metric": "colocated_2x0.5_aggregate_ratio",
+        "value": round(ratio, 4),
+        "unit": "fraction",
+        "vs_baseline": round(ratio / 0.90, 4),
+        "exclusive_steps_per_sec": round(exclusive_sps, 2),
+        "colocated_aggregate_steps_per_sec": round(aggregate_sps, 2),
+        "client_steps_per_sec": [round(a["steps_per_sec"], 2),
+                                 round(b["steps_per_sec"], 2)],
+        "share_error_pct": round(share_error_pct, 2),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench.py", description=__doc__)
+    parser.add_argument("--exclusive-seconds", type=float, default=4.0)
+    parser.add_argument("--colocated-seconds", type=float, default=8.0)
+    parser.add_argument("--chunk", type=int, default=100,
+                        help="train steps fused per dispatch (one token burst)")
+    args = parser.parse_args(argv)
+    result = run_bench(args.exclusive_seconds, args.colocated_seconds,
+                       args.chunk)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

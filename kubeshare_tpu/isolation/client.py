@@ -63,21 +63,73 @@ class RemoteExecutable:
         import jax
         leaves = jax.tree_util.tree_leaves(args)
         bufs, uploaded = [], []
-        for leaf in leaves:
-            if isinstance(leaf, RemoteBuffer):
-                bufs.append(leaf)
-            else:
-                buf = self._client.put(leaf)
-                bufs.append(buf)
-                uploaded.append(buf)
         # donate=True donates every argument (uploaded ones included);
-        # otherwise per-call uploads are freed here — the caller never sees
-        # their handles, so nobody else can.
-        handles = self._client._execute(
-            self._exec_id, [b.handle for b in bufs],
-            donate=[b.handle for b in bufs] if donate else ())
+        # otherwise per-call uploads are freed afterwards — including on
+        # any failure from the upload loop onward (a retried step must not
+        # leak its auto-uploads against the HBM cap). Donation frees only
+        # after success, so the failure path never double-frees; the
+        # failure-path free is best-effort (the failure may have been the
+        # connection itself dying — the original error must win).
+        try:
+            for leaf in leaves:
+                if isinstance(leaf, RemoteBuffer):
+                    bufs.append(leaf)
+                else:
+                    buf = self._client.put(leaf)
+                    bufs.append(buf)
+                    uploaded.append(buf)
+            handles = self._client._execute(
+                self._exec_id, [b.handle for b in bufs],
+                donate=[b.handle for b in bufs] if donate else ())
+        except Exception:
+            if uploaded:
+                try:
+                    self._client.free(*uploaded)
+                except Exception:
+                    pass
+            raise
         if not donate and uploaded:
             self._client.free(*uploaded)
+        out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
+                    for h, (shape, dtype) in zip(handles, self.out_meta)]
+        return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
+
+
+class RemoteLoop:
+    """A compiled loop program (see :meth:`ProxyClient.compile_loop`).
+
+    ``new_carry, aux = loop(n, carry, *consts)`` runs ``n`` fused
+    iterations on the proxy. The previous carry's device buffers are
+    donated (freed) on success — the carry *threads*; consts persist.
+    """
+
+    def __init__(self, client: "ProxyClient", exec_id: int, in_tree, out_tree,
+                 out_meta: list[tuple[list[int], str]], ncarry: int):
+        self._client = client
+        self._exec_id = exec_id
+        self._in_tree = in_tree
+        self._out_tree = out_tree
+        self.out_meta = out_meta
+        self._ncarry = ncarry
+        #: iterations the proxy actually ran on the last call — it may clamp
+        #: a long burst to keep one dispatch near the scheduling quantum.
+        self.last_n = 0
+
+    def __call__(self, n: int, carry, *consts):
+        import jax
+        if int(n) < 1:
+            # Clamping 0 → 1 would silently apply an extra step to the
+            # carry; a true 0-iteration call can't exist (the carry would
+            # have to pass through untouched).
+            raise ValueError(f"loop count must be >= 1, got {n}")
+        leaves = jax.tree_util.tree_leaves((carry, *consts))
+        if not all(isinstance(x, RemoteBuffer) for x in leaves):
+            raise TypeError("RemoteLoop args must be device-resident "
+                            "(put them first)")
+        carry_handles = [b.handle for b in leaves[:self._ncarry]]
+        handles, self.last_n = self._client._execute_n(
+            self._exec_id, [b.handle for b in leaves],
+            donate=carry_handles, repeat=int(n))
         out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
                     for h, (shape, dtype) in zip(handles, self.out_meta)]
         return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
@@ -131,13 +183,10 @@ class ProxyClient:
 
     # -- programs ------------------------------------------------------------
 
-    def compile(self, fn, *example_args) -> RemoteExecutable:
-        """Trace ``fn`` locally (abstract — no local execution), serialize,
-        and compile it on the proxy's chip.
-
-        ``example_args`` may contain host arrays, :class:`RemoteBuffer`\\ s,
-        or ``jax.ShapeDtypeStruct``\\ s — only shapes/dtypes matter.
-        """
+    def _trace_and_compile(self, fn, example_args, ncarry: int | None):
+        """Trace ``fn`` abstractly over ``example_args``, export StableHLO
+        for the proxy's platform, compile remotely. Returns
+        ``(exec_id, in_tree, out_tree, out_meta)``."""
         import jax
         from jax import export
 
@@ -161,19 +210,63 @@ class ProxyClient:
             return tuple(out_leaves)
 
         exported = export.export(
-            jax.jit(flat_fn),
-            platforms=sorted(set(self.platforms) | {"cpu"}))(*flat_specs)
-        reply, _ = self._conn.call({"op": "compile", "name": self.name},
-                                   blob=exported.serialize())
-        return RemoteExecutable(self, reply["exec_id"], in_tree,
-                                out_tree_store[0], reply["out_meta"])
+            jax.jit(flat_fn), platforms=list(self.platforms))(*flat_specs)
+        msg = {"op": "compile", "name": self.name}
+        if ncarry is not None:
+            msg["ncarry"] = ncarry
+        reply, _ = self._conn.call(msg, blob=exported.serialize())
+        return reply["exec_id"], in_tree, out_tree_store[0], reply["out_meta"]
+
+    def compile(self, fn, *example_args) -> RemoteExecutable:
+        """Trace ``fn`` locally (abstract — no local execution), serialize,
+        and compile it on the proxy's chip.
+
+        ``example_args`` may contain host arrays, :class:`RemoteBuffer`\\ s,
+        or ``jax.ShapeDtypeStruct``\\ s — only shapes/dtypes matter.
+        """
+        exec_id, in_tree, out_tree, out_meta = self._trace_and_compile(
+            fn, example_args, None)
+        return RemoteExecutable(self, exec_id, in_tree, out_tree, out_meta)
+
+    def compile_loop(self, fn, carry, *consts) -> "RemoteLoop":
+        """Compile ``fn(carry, *consts) -> (carry, aux)`` as a *loop
+        program*: :class:`RemoteLoop` runs N iterations per dispatch, the
+        proxy fusing them into one XLA execution (``lax.fori_loop``).
+
+        This is the TPU-native hot path for training: per-step round trips
+        (client ⇄ proxy ⇄ chip transport) disappear; one token-gated burst
+        covers N steps, exactly the kernel-burst unit the reference's
+        Gemini meters (``launcher.py:78-80``).
+        """
+        import jax
+
+        carry_leaves, carry_tree = jax.tree_util.tree_flatten(carry)
+        ncarry = len(carry_leaves)
+
+        def checked_fn(c, *cs):
+            new_carry, aux = fn(c, *cs)
+            new_tree = jax.tree_util.tree_structure(new_carry)
+            if new_tree != jax.tree_util.tree_structure(c):
+                raise TypeError(
+                    f"loop fn must preserve carry structure: {new_tree} "
+                    f"!= {jax.tree_util.tree_structure(c)}")
+            return new_carry, aux
+
+        exec_id, in_tree, out_tree, out_meta = self._trace_and_compile(
+            checked_fn, (carry, *consts), ncarry)
+        return RemoteLoop(self, exec_id, in_tree, out_tree, out_meta, ncarry)
 
     def _execute(self, exec_id: int, handles: list[int],
-                 donate=()) -> list[int]:
+                 donate=(), repeat: int = 1) -> list[int]:
+        return self._execute_n(exec_id, handles, donate, repeat)[0]
+
+    def _execute_n(self, exec_id: int, handles: list[int],
+                   donate=(), repeat: int = 1) -> tuple[list[int], int]:
         reply, _ = self._conn.call({"op": "execute", "name": self.name,
                                     "exec_id": exec_id, "args": handles,
-                                    "donate": list(donate)})
-        return list(reply["handles"])
+                                    "donate": list(donate),
+                                    "repeat": repeat})
+        return list(reply["handles"]), int(reply.get("repeat", repeat))
 
     def usage(self) -> dict:
         reply, _ = self._conn.call({"op": "usage", "name": self.name})

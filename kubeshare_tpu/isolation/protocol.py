@@ -38,6 +38,12 @@ class ProtocolError(ConnectionError):
     pass
 
 
+class FrameTooLarge(ValueError):
+    """Raised before any bytes hit the wire — the stream stays in sync, so
+    callers must NOT tear down the connection for it (one oversized ``put``
+    would otherwise destroy the whole session's device state)."""
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -51,11 +57,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def send_msg(sock: socket.socket, msg: dict, blob: bytes | None = None) -> None:
     if blob is not None:
         if len(blob) > MAX_FRAME:
-            raise ProtocolError(f"blob too large: {len(blob)}")
+            raise FrameTooLarge(f"blob too large: {len(blob)}")
         msg = dict(msg, _blob=len(blob))
     data = json.dumps(msg).encode()
     if len(data) > MAX_FRAME:
-        raise ProtocolError(f"frame too large: {len(data)}")
+        raise FrameTooLarge(f"frame too large: {len(data)}")
     sock.sendall(_HDR.pack(len(data)) + data + (blob or b""))
 
 

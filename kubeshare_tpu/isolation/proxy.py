@@ -39,6 +39,7 @@ from .tokensched import TokenScheduler
 log = get_logger("proxy")
 
 IDLE_RELEASE_MS = 10.0
+FIRST_BURST_STEPS = 128   # burst cap before a per-step time estimate exists
 
 
 def _now_ms() -> float:
@@ -48,9 +49,14 @@ def _now_ms() -> float:
 @dataclass
 class _Executable:
     exec_id: int
-    fn: object                    # jitted call on the proxy's backend
+    call: object                  # the raw exported call (traceable)
+    in_specs: list                # ShapeDtypeStruct per arg
     out_nbytes: int               # total output allocation, pre-checked
     out_meta: list[tuple[list[int], str]]  # (shape, dtype) per output
+    ncarry: int | None = None     # loop programs: first ncarry args/outs thread
+    fn: object = None             # AOT-compiled single call (lazy)
+    chunk: object = None          # AOT-compiled dynamic-n loop (lazy)
+    step_ms: float = 0.0          # EMA of per-iteration device time
 
 
 @dataclass
@@ -310,7 +316,7 @@ class ChipProxy:
             return {"ok": True}
 
         if op == "compile":
-            return self._compile(sess, state["blob"])
+            return self._compile(sess, state["blob"], req.get("ncarry"))
 
         if op == "execute":
             return self._execute(sess, req)
@@ -330,31 +336,124 @@ class ChipProxy:
 
         return {"ok": False, "error": f"unknown op {op!r}"}
 
-    def _compile(self, sess: _Session, blob: bytes) -> dict:
+    def _compile(self, sess: _Session, blob: bytes,
+                 ncarry: int | None = None) -> dict:
         from jax import export
         exported = export.deserialize(blob)
         out_meta = [(list(a.shape), str(a.dtype)) for a in exported.out_avals]
         out_nbytes = sum(
             int(np.prod(shape or [1])) * np.dtype(dtype).itemsize
             for shape, dtype in out_meta)
-        fn = self._jax.jit(exported.call)
+        in_specs = [self._jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in exported.in_avals]
         exec_id = sess.fresh_id()
-        sess.executables[exec_id] = _Executable(exec_id, fn, out_nbytes, out_meta)
+        sess.executables[exec_id] = _Executable(
+            exec_id, exported.call, in_specs, out_nbytes, out_meta,
+            ncarry=None if ncarry is None else int(ncarry))
         return {"ok": True, "exec_id": exec_id,
                 "out_meta": out_meta, "out_nbytes": out_nbytes}
+
+    def _single_fn(self, exe: _Executable):
+        """AOT-compile the single-call program (lazily, OUTSIDE the token
+        gate — a multi-second XLA compile charged as device usage would
+        lock the client out for windows and starve everyone else of the
+        token meanwhile).
+
+        A plain wrapper traced by jit, not jit(exported.call): the
+        exported-call object itself defeats pjit's C++ fast path, and the
+        slow per-call python dispatch re-stages every argument — ruinous
+        when the chip sits behind a transport (each step would re-ship the
+        full parameter set).
+        """
+        if exe.fn is None:
+            call = exe.call
+
+            def _single(*args):
+                return call(*args)
+
+            exe.fn = (self._jax.jit(_single)
+                      .lower(*exe.in_specs).compile())
+        return exe.fn
+
+    def _chunk_fn(self, exe: _Executable):
+        """N executions fused into ONE XLA program via ``lax.fori_loop``
+        with a *dynamic* trip count — the TPU-native answer to per-step
+        dispatch overhead. The first ``ncarry`` outputs feed back into the
+        first ``ncarry`` args each iteration (train-step carry); the rest
+        are loop-invariant. One dispatch, one token-gated burst, buffers
+        stay device-resident throughout; one compile serves every N.
+        """
+        if exe.chunk is None:
+            jax = self._jax
+            call, ncarry = exe.call, exe.ncarry
+
+            def chunk(n, *args):
+                carry, consts = args[:ncarry], args[ncarry:]
+                outs = call(*carry, *consts)
+
+                def body(_, c):
+                    cur_carry, _aux = c
+                    o = call(*cur_carry, *consts)
+                    return tuple(o[:ncarry]), tuple(o[ncarry:])
+
+                init = (tuple(outs[:ncarry]), tuple(outs[ncarry:]))
+                final_carry, aux = jax.lax.fori_loop(0, n - 1, body, init)
+                return list(final_carry + aux)
+
+            nspec = jax.ShapeDtypeStruct((), np.int32)
+            # The protocol always donates the carry (RemoteLoop frees those
+            # handles on success), so give XLA the aliasing: without it a
+            # training client needs 2x its state in HBM at every dispatch.
+            exe.chunk = (jax.jit(chunk,
+                                 donate_argnums=tuple(range(1, ncarry + 1)))
+                         .lower(nspec, *exe.in_specs).compile())
+        return exe.chunk
+
+    def _cap_repeat(self, exe: _Executable, repeat: int) -> int:
+        """Clamp a client-requested burst length. The fused loop is one
+        unpreemptible XLA execution, so an unbounded ``repeat`` would let a
+        client monopolize the chip past its quota AND slip usage out of the
+        sliding window. Cap the estimated burst near the scheduler's base
+        quantum (Gemini's burst ≙ quota relationship); before any timing
+        exists, allow a modest first burst to seed the estimate.
+        """
+        core = getattr(self.scheduler, "core", None)
+        base = getattr(core, "base_quota_ms", 300.0)
+        if exe.step_ms <= 0.0:
+            return min(repeat, FIRST_BURST_STEPS)
+        return max(1, min(repeat, int(2.0 * base / exe.step_ms) or 1))
 
     def _execute(self, sess: _Session, req: dict) -> dict:
         exe = sess.executables[int(req["exec_id"])]
         args = [sess.buffers[int(h)] for h in req["args"]]
         donate = [int(h) for h in req.get("donate", [])]
+        repeat = int(req.get("repeat", 1))
+        if repeat < 1:
+            raise ValueError(f"repeat must be >= 1, got {repeat}")
+        if repeat > 1 and exe.ncarry is None:
+            raise ValueError("repeat requires a loop program (compile with "
+                             "ncarry / ProxyClient.compile_loop)")
+        if exe.ncarry is not None:
+            # All loop-program dispatches ride the chunk executable (its
+            # fori_loop is a no-op at n=1) — a 1-step tail must not pay a
+            # second full XLA compile via the single path.
+            repeat = self._cap_repeat(exe, repeat)
+            fn = self._chunk_fn(exe)
+            args = [np.int32(repeat), *args]
+        else:
+            fn = self._single_fn(exe)
         # Cap check up front — allocation must not happen over-cap even
         # transiently (donated buffers are freed only after success).
         self._charge(sess, exe.out_nbytes)
+        start = _now_ms()
         try:
-            outs = self._gated(sess, lambda: self._run(exe, args))
+            outs = self._gated(sess, lambda: self._run_fn(fn, args))
         except Exception:
             sess.hbm_used -= exe.out_nbytes
             raise
+        per_step = (_now_ms() - start) / repeat
+        exe.step_ms = (per_step if exe.step_ms <= 0.0
+                       else 0.5 * exe.step_ms + 0.5 * per_step)
         handles = []
         for out in outs:
             handle = sess.fresh_id()
@@ -364,10 +463,10 @@ class ChipProxy:
             buf = sess.buffers.pop(handle, None)
             if buf is not None:
                 sess.hbm_used -= int(buf.nbytes)
-        return {"ok": True, "handles": handles}
+        return {"ok": True, "handles": handles, "repeat": repeat}
 
-    def _run(self, exe: _Executable, args: list):
-        outs = exe.fn(*args)
+    def _run_fn(self, fn, args: list):
+        outs = fn(*args)
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
         self._jax.block_until_ready(outs)

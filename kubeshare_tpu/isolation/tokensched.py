@@ -76,8 +76,11 @@ class PyTokenCore:
         self.min_quota_ms = min_quota_ms
         self._clients: dict[str, _PyClient] = {}
         self._holder: str | None = None
+        self._closed = False
 
     def add_client(self, name: str, request: float, limit: float) -> None:
+        if self._closed:
+            raise RuntimeError("token scheduler closed")
         if request <= 0 or limit <= 0 or limit > 1 or request > limit:
             raise ValueError(f"bad request/limit: {request}/{limit}")
         if name in self._clients:
@@ -101,6 +104,10 @@ class PyTokenCore:
     def poll(self, now_ms: float) -> tuple[str, float] | float:
         """Grant ``(name, quota_ms)`` or return the next wake time (ms,
         may be inf)."""
+        if self._closed:
+            # Same contract as the native core's freed-handle guard: a
+            # waiter woken by close() must error out, not sleep forever.
+            raise RuntimeError("token scheduler closed")
         if self._holder is not None:
             return _INF
         best: _PyClient | None = None
@@ -143,7 +150,9 @@ class PyTokenCore:
         return len(self._clients)
 
     def close(self) -> None:
-        pass
+        self._closed = True
+        self._clients.clear()
+        self._holder = None
 
 
 # --------------------------------------------------------------------------
@@ -184,51 +193,60 @@ class NativeTokenCore:
         self.base_quota_ms = base_quota_ms
         self.min_quota_ms = min_quota_ms
 
+    def _handle(self):
+        # Guard every native call: after close() the C++ scheduler is
+        # freed, and a stale handle would be a use-after-free (a waiter
+        # woken by close would otherwise segfault the whole proxy).
+        h = self._h
+        if not h:
+            raise RuntimeError("token scheduler closed")
+        return h
+
     def add_client(self, name: str, request: float, limit: float) -> None:
-        rc = self._lib.ts_add_client(self._h, name.encode(), request, limit)
+        rc = self._lib.ts_add_client(self._handle(), name.encode(), request, limit)
         if rc == -1:
             raise ValueError(f"bad request/limit: {request}/{limit}")
         if rc == -2:
             raise ValueError(f"duplicate client {name}")
 
     def remove_client(self, name: str) -> None:
-        self._lib.ts_remove_client(self._h, name.encode())
+        self._lib.ts_remove_client(self._handle(), name.encode())
 
     def request_token(self, name: str) -> None:
-        if self._lib.ts_request_token(self._h, name.encode()) != 0:
+        if self._lib.ts_request_token(self._handle(), name.encode()) != 0:
             raise KeyError(name)
 
     def cancel_request(self, name: str) -> None:
-        self._lib.ts_cancel_request(self._h, name.encode())
+        self._lib.ts_cancel_request(self._handle(), name.encode())
 
     def poll(self, now_ms: float):
         buf = ctypes.create_string_buffer(256)
         quota = ctypes.c_double()
         wake = ctypes.c_double()
-        rc = self._lib.ts_poll(self._h, now_ms, buf, len(buf),
+        rc = self._lib.ts_poll(self._handle(), now_ms, buf, len(buf),
                                ctypes.byref(quota), ctypes.byref(wake))
         if rc == 1:
             return buf.value.decode(), quota.value
         return wake.value
 
     def release_token(self, name: str, used_ms: float, now_ms: float) -> None:
-        if self._lib.ts_release_token(self._h, name.encode(), used_ms, now_ms) != 0:
+        if self._lib.ts_release_token(self._handle(), name.encode(), used_ms, now_ms) != 0:
             raise ValueError(f"{name} does not hold the token")
 
     def window_usage(self, name: str, now_ms: float) -> float:
-        u = self._lib.ts_window_usage(self._h, name.encode(), now_ms)
+        u = self._lib.ts_window_usage(self._handle(), name.encode(), now_ms)
         if u < 0:
             raise KeyError(name)
         return u
 
     def holder(self) -> str | None:
         buf = ctypes.create_string_buffer(256)
-        if self._lib.ts_holder(self._h, buf, len(buf)):
+        if self._lib.ts_holder(self._handle(), buf, len(buf)):
             return buf.value.decode()
         return None
 
     def client_count(self) -> int:
-        return self._lib.ts_client_count(self._h)
+        return self._lib.ts_client_count(self._handle())
 
     def close(self) -> None:
         if self._h:
@@ -376,6 +394,9 @@ class TokenScheduler:
     def close(self) -> None:
         with self._cond:
             self._core.close()
+            # Wake every blocked waiter so it hits the closed-core guard
+            # instead of sleeping forever on a grant that can never come.
+            self._cond.notify_all()
 
 
 def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
@@ -442,6 +463,9 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
 
     def cleanup(state: dict) -> None:
         if state.get("owner") and state.get("name"):
-            scheduler.remove_client(state["name"])
+            try:
+                scheduler.remove_client(state["name"])
+            except RuntimeError:
+                pass  # scheduler already closed — nothing left to free
 
     return protocol.serve_framed(host, port, handle, cleanup)

@@ -1,0 +1,23 @@
+"""Smoke test for the driver-facing benchmark entry point.
+
+Runs the real co-location experiment at toy durations on the CPU backend —
+the identical code path ``bench.py`` exercises on the chip.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import run_bench  # noqa: E402
+
+
+def test_bench_produces_driver_contract():
+    result = run_bench(exclusive_s=0.5, colocated_s=1.5, chunk=10)
+    assert result["metric"] == "colocated_2x0.5_aggregate_ratio"
+    assert result["unit"] == "fraction"
+    assert result["value"] > 0
+    assert result["vs_baseline"] > 0
+    assert len(result["client_steps_per_sec"]) == 2
+    assert all(s > 0 for s in result["client_steps_per_sec"])
+    assert 0 <= result["share_error_pct"] <= 100

@@ -228,6 +228,72 @@ def test_limit_cap_holds_solo_client(proxy):
     assert used["ms"] / elapsed_ms <= 0.40, used
 
 
+def test_oversized_put_keeps_session(proxy, monkeypatch):
+    """A pre-send frame-size refusal must not tear down the connection —
+    the stream never desynced, and closing would drop every device buffer."""
+    with connect(proxy, "c") as c:
+        buf = c.put(np.ones(4, np.float32))
+        monkeypatch.setattr(protocol, "MAX_FRAME", 64)
+        with pytest.raises(protocol.FrameTooLarge):
+            c.put(np.ones(1024, np.float32))
+        monkeypatch.setattr(protocol, "MAX_FRAME", 1 << 30)
+        np.testing.assert_array_equal(c.get(buf), np.ones(4, np.float32))
+
+
+def test_compile_loop_fuses_steps(proxy):
+    """The fused-loop path runs N optimizer steps per dispatch and matches
+    the per-step path's math."""
+    rng = np.random.default_rng(1)
+    w_true = rng.normal(size=(4,)).astype(np.float32)
+    xs = rng.normal(size=(64, 4)).astype(np.float32)
+    ys = xs @ w_true
+
+    def step(w, batch):
+        xb, yb = batch
+        def loss(w):
+            return jnp.mean((xb @ w - yb) ** 2)
+        l, g = jax.value_and_grad(loss)(w)
+        return w - 0.1 * g, l
+
+    with connect(proxy, "looper") as c:
+        w = c.put(np.zeros(4, np.float32))
+        batch = (c.put(xs), c.put(ys))
+        loop = c.compile_loop(step, w, batch)
+        used_before = c.usage()["exec_count"]
+        w, l = loop(60, w, batch)
+        assert c.usage()["exec_count"] == used_before + 1  # ONE dispatch
+        assert float(c.get(l)) < 1e-3
+        np.testing.assert_allclose(c.get(w), w_true, atol=1e-2)
+        # old carry was donated: only w, l, xs, ys alive
+        expected = c.get(w).nbytes + c.get(l).nbytes + xs.nbytes + ys.nbytes
+        assert c.usage()["hbm_used"] == expected
+
+
+def test_compile_loop_repeat_one(proxy):
+    with connect(proxy, "one") as c:
+        w = c.put(np.float32(2.0))
+        loop = c.compile_loop(lambda w: (w * 2.0, w), w)
+        w2, aux = loop(1, w)
+        assert float(c.get(w2)) == 4.0
+        assert float(c.get(aux)) == 2.0
+
+
+def test_plain_execute_rejects_repeat(proxy):
+    with connect(proxy, "c") as c:
+        x = np.ones(3, np.float32)
+        exe = c.compile(lambda a: a + 1.0, x)
+        bx = c.put(x)
+        with pytest.raises(RuntimeError, match="loop program"):
+            c._execute(exe._exec_id, [bx.handle], repeat=5)
+
+
+def test_loop_carry_structure_checked(proxy):
+    with connect(proxy, "bad") as c:
+        w = c.put(np.float32(1.0))
+        with pytest.raises(TypeError, match="carry structure"):
+            c.compile_loop(lambda w: ((w, w), w), w)
+
+
 # --------------------------------------------------------------------------
 # Pod manager + gate
 # --------------------------------------------------------------------------
