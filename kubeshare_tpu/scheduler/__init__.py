@@ -1,0 +1,15 @@
+"""The placement engine: eight extension points over the cell model.
+
+Kubernetes-independent re-design of ``pkg/scheduler`` — see
+:mod:`.engine` for the parity map.
+"""
+
+from .engine import Binding, SchedulerEngine, Unschedulable
+from .labels import LabelError, PodRequest, parse_pod_labels
+from .podgroup import PodGroup, PodGroupRegistry, queue_less
+
+__all__ = [
+    "Binding", "SchedulerEngine", "Unschedulable",
+    "LabelError", "PodRequest", "parse_pod_labels",
+    "PodGroup", "PodGroupRegistry", "queue_less",
+]

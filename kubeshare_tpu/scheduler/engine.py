@@ -1,0 +1,422 @@
+"""The placement engine — the reference's eight extension points as a
+standalone, Kubernetes-independent core.
+
+Re-design of ``pkg/scheduler/scheduler.go:247-587`` + ``pod.go``. The
+engine consumes parsed workloads (:mod:`.labels`) and chip inventories
+(:mod:`..topology.discovery`), and produces :class:`Binding` records —
+the annotations + environment the reference realizes via its delete/
+recreate "shadow pod" swap (``scheduler.go:515-528``). That swap changes
+the pod UID and is the reference's ugliest behavior (SURVEY §7.0.4); here
+the binding is a value an admission webhook / node agent applies, so the
+engine stays pure and replayable.
+
+Extension-point parity map:
+
+- ``queue_less``       ≙ Less (scheduler.go:247-267), via :mod:`.podgroup`
+- ``pre_filter``       ≙ PreFilter (scheduler.go:275-324)
+- ``filter``           ≙ Filter (scheduler.go:332-408 + filter.go)
+- ``score``/``normalize_scores`` ≙ Score/NormalizeScore (scheduler.go:415-487)
+- ``reserve``          ≙ Reserve (scheduler.go:489-531 + pod.go:348-476)
+- ``unreserve``        ≙ Unreserve (scheduler.go:534-549)
+- ``permit``           ≙ Permit gang barrier (scheduler.go:551-587)
+- ``delete_pod``       ≙ deletePod reclaim (pod.go:91-136)
+- ``resync_bound``     ≙ bound-pod crash resync (pod.go:528-617)
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+from .. import constants as C
+from ..topology.cell import (CellConstructor, FreeList, build_cell_chains,
+                             reclaim_resource, reserve_resource,
+                             set_node_status)
+from ..topology.cellconfig import TopologyConfig, config_from_chips
+from ..topology.chip import ChipInfo
+from ..utils.bitmap import RRBitmap
+from ..utils.logger import get_logger
+from .filtering import filter_node
+from .labels import LabelError, PodRequest, parse_pod_labels
+from .podgroup import PodGroup, PodGroupRegistry, queue_less
+from .scoring import (normalize_scores, score_guarantee_node,
+                      score_opportunistic_node, score_regular_node,
+                      select_cells)
+
+log = get_logger("scheduler")
+
+PERMIT_WAIT_BASE_S = 2.0  # × headcount (scheduler.go:44,573)
+
+
+class Unschedulable(RuntimeError):
+    pass
+
+
+@dataclass
+class Binding:
+    """The realized placement — annotations + env the reference injects
+    into its recreated pod (pod.go:348-476), TPU vocabulary."""
+
+    pod_key: str
+    node: str
+    chip_ids: list[str]
+    cell_ids: list[str]
+    models: list[str]
+    memory: int
+    port: int = 0                 # 0 for whole-chip pods (no manager)
+
+    @property
+    def annotations(self) -> dict[str, str]:
+        ann = {
+            C.POD_TPU_CHIP_ID: ",".join(self.chip_ids),
+            C.POD_CELL_ID: ",".join(self.cell_ids),
+            C.POD_TPU_MEMORY: str(self.memory),
+            C.POD_TPU_MODEL: ",".join(self.models),
+        }
+        if self.port:
+            ann[C.POD_MANAGER_PORT] = str(self.port)
+        return ann
+
+    @property
+    def env(self) -> dict[str, str]:
+        env = {C.ENV_VISIBLE_CHIPS: ",".join(self.chip_ids)}
+        if self.port:
+            env[C.ENV_POD_MANAGER_PORT] = str(self.port)
+            env[C.ENV_POD_NAME] = self.pod_key
+        return env
+
+
+class SchedulerEngine:
+    """Placement engine over the cell resource model."""
+
+    def __init__(self, config: TopologyConfig | None = None,
+                 permit_wait_base_s: float = PERMIT_WAIT_BASE_S,
+                 mesh_shape: tuple[int, ...] | None = None,
+                 clock=time.monotonic):
+        self._config = config
+        self._auto_config = config is None
+        self.elements = None
+        self.chip_priority: dict[str, int] = {}
+        self.free_list: FreeList = {}
+        self.leaf_cells: dict = {}
+        self.chips_by_node: dict[str, dict[str, list[ChipInfo]]] = {}
+        self.node_health: dict[str, bool] = {}
+        self.ports: dict[str, RRBitmap] = {}
+        self.pod_status: dict[str, PodRequest] = {}
+        self.groups = PodGroupRegistry(clock=clock)
+        self.permit_wait_base_s = permit_wait_base_s
+        self.mesh_shape = mesh_shape
+        self._clock = clock
+        if config is not None:
+            self._build(config)
+
+    # -- topology ----------------------------------------------------------
+
+    def _build(self, config: TopologyConfig) -> None:
+        self._config = config
+        self.elements, self.chip_priority = build_cell_chains(config.cell_types)
+        self.free_list = CellConstructor(self.elements, config.cells).build()
+
+    def add_node(self, node_name: str, chips: list[ChipInfo],
+                 healthy: bool = True) -> None:
+        """Feed one node's chip inventory (≙ addNode + getGPUByNode +
+        setNodeStatus, node.go:28-52). With no explicit cluster config the
+        topology is auto-derived from the accumulated fleet (SURVEY §7.0.2
+        — topology is discoverable on TPU; the reference requires a
+        hand-written file). Auto-derivation rebuilds the cell trees on
+        every new node and re-books live workloads onto the fresh trees —
+        the same replay the crash resync performs."""
+        is_new = node_name not in self.chips_by_node
+        by_model: dict[str, list[ChipInfo]] = {}
+        for chip in chips:
+            by_model.setdefault(chip.model, []).append(chip)
+        self.chips_by_node[node_name] = by_model
+        self.node_health[node_name] = healthy
+        if node_name not in self.ports:
+            bitmap = RRBitmap(C.POD_MANAGER_PORT_RANGE)
+            bitmap.mask(0)  # parity: port base is never handed out
+            self.ports[node_name] = bitmap
+        if self._auto_config and (is_new or self._config is None):
+            self._rebuild_auto_config()
+        else:
+            set_node_status(self.free_list, self.chips_by_node,
+                            self.leaf_cells, node_name, healthy)
+
+    def _rebuild_auto_config(self) -> None:
+        all_chips = [c for models in self.chips_by_node.values()
+                     for chips_ in models.values() for c in chips_]
+        self._build(config_from_chips(all_chips))
+        self.leaf_cells.clear()
+        for node, healthy in self.node_health.items():
+            set_node_status(self.free_list, self.chips_by_node,
+                            self.leaf_cells, node, healthy)
+        # replay live bookings onto the fresh trees (ports stay masked —
+        # the bitmaps are per-node state, untouched by the rebuild)
+        for pod in self.pod_status.values():
+            if not pod.chip_ids:
+                continue
+            cells = [self.leaf_cells[cid] for cid in pod.chip_ids
+                     if cid in self.leaf_cells]
+            pod.cells = cells
+            for cell in cells:
+                if pod.multi_chip:
+                    reserve_resource(cell, cell.leaf_cell_number,
+                                     cell.full_memory)
+                else:
+                    reserve_resource(cell, pod.request, pod.memory)
+
+    def set_node_health(self, node_name: str, healthy: bool) -> None:
+        self.node_health[node_name] = healthy
+        set_node_status(self.free_list, self.chips_by_node, self.leaf_cells,
+                        node_name, healthy)
+
+    @property
+    def nodes(self) -> list[str]:
+        return sorted(self.chips_by_node)
+
+    # -- workload intake ---------------------------------------------------
+
+    def submit(self, namespace: str, name: str, labels: dict,
+               uid: str = "") -> PodRequest:
+        """Parse + register a workload (≙ the pod informer's addPod +
+        getPodLabels caching, pod.go:47-78,207-218)."""
+        pod = parse_pod_labels(namespace, name, labels, uid=uid)
+        cached = self.pod_status.get(pod.key)
+        if cached is not None:
+            if not uid or cached.uid == uid:
+                return cached
+            # Same key, new incarnation: the old pod's bookings would leak
+            # forever if simply overwritten (its delete event can no longer
+            # find them).
+            self._reclaim(cached)
+        pod.timestamp = self._clock()
+        self.pod_status[pod.key] = pod
+        self.groups.get_or_create(pod)
+        return pod
+
+    def group_of(self, pod: PodRequest) -> PodGroup:
+        return self.groups.get_or_create(pod)
+
+    def queue_less(self, pod_a: PodRequest, pod_b: PodRequest) -> bool:
+        return queue_less(pod_a, self.group_of(pod_a),
+                          pod_b, self.group_of(pod_b))
+
+    def _group_members(self, pod: PodRequest) -> list[PodRequest]:
+        if not pod.group_name:
+            return []
+        return [p for p in self.pod_status.values()
+                if p.group_name == pod.group_name
+                and p.namespace == pod.namespace]
+
+    def _group_cells(self, pod: PodRequest) -> list:
+        return [cell for member in self._group_members(pod)
+                for cell in member.cells]
+
+    # -- extension points --------------------------------------------------
+
+    def pre_filter(self, pod: PodRequest) -> tuple[bool, str]:
+        """Gang sanity gate (PreFilter, scheduler.go:275-324); label
+        validity was already enforced at parse time."""
+        group = self.group_of(pod)
+        if not group.key:
+            return True, "regular pod"
+        if pod.min_available != group.min_available:
+            return False, (f"pod min_available {pod.min_available} != group "
+                           f"{group.name} min_available {group.min_available}")
+        if pod.priority != group.priority:
+            return False, (f"pod priority {pod.priority} != group "
+                           f"{group.name} priority {group.priority}")
+        total = len(self._group_members(pod))
+        if total < group.min_available:
+            return False, (f"group {group.name} has {total} pods < "
+                           f"min_available {group.min_available}")
+        return True, ""
+
+    def filter(self, pod: PodRequest, node_name: str) -> tuple[bool, str]:
+        if not pod.needs_tpu:
+            return True, ""
+        ports = self.ports.get(node_name)
+        if ports is None:
+            return False, f"unknown node {node_name}"
+        if not pod.multi_chip and ports.count() >= C.POD_MANAGER_PORT_RANGE:
+            return False, f"node {node_name} pod-manager port pool exhausted"
+        models = self.chips_by_node.get(node_name, {})
+        if pod.model:
+            if pod.model not in models:
+                return False, (f"node {node_name} has no {pod.model} chips")
+            fit, _, _ = filter_node(self.free_list, node_name, pod.model,
+                                    pod.request, pod.memory)
+            return (fit, "" if fit else
+                    f"node {node_name} cannot fit {pod.request}")
+        available = 0.0
+        free_mem = 0
+        for model in models:
+            fit, cur_avail, cur_mem = filter_node(
+                self.free_list, node_name, model, pod.request, pod.memory)
+            available += cur_avail
+            free_mem += cur_mem
+            if fit or (available >= pod.request and free_mem >= pod.memory):
+                return True, ""
+        return False, f"node {node_name} cannot fit {pod.request}"
+
+    def score(self, pod: PodRequest, node_name: str) -> float:
+        from .filtering import node_leaf_cells
+        if not pod.needs_tpu:
+            return score_regular_node(bool(self.chips_by_node.get(node_name)))
+        leaves = node_leaf_cells(self.free_list, node_name, pod.model)
+        if pod.opportunistic:
+            return score_opportunistic_node(leaves, self.chip_priority)
+        return score_guarantee_node(leaves, self.chip_priority,
+                                    self._group_cells(pod), self.mesh_shape)
+
+    normalize_scores = staticmethod(normalize_scores)
+
+    def reserve(self, pod: PodRequest, node_name: str) -> Binding:
+        """Pick cells, book them, allocate the manager port, emit the
+        binding (Reserve, scheduler.go:489-531 + pod.go:348-476)."""
+        if not pod.needs_tpu:
+            pod.node_name = node_name
+            return Binding(pod.key, node_name, [], [], [], 0)
+        cells = select_cells(self.free_list, node_name, pod,
+                             self.chip_priority, self._group_cells(pod),
+                             self.mesh_shape)
+        if not cells:
+            raise Unschedulable(
+                f"{pod.key}: no cell on {node_name} fits "
+                f"request={pod.request} memory={pod.memory}")
+        pod.node_name = node_name
+        pod.cells = cells
+        pod.chip_ids = [c.chip_id for c in cells]
+        if pod.multi_chip:
+            # whole leaves: book everything they have (pod.go:360-366)
+            memory = 0
+            for cell in cells:
+                memory += cell.free_memory
+                reserve_resource(cell, cell.available, cell.free_memory)
+            pod.memory = memory
+            return Binding(pod.key, node_name, pod.chip_ids,
+                           [c.id for c in cells],
+                           [c.cell_type for c in cells], memory)
+        cell = cells[0]
+        if pod.memory == 0:
+            # default the HBM cap to the compute fraction of the chip
+            # (pod.go:419-424)
+            pod.memory = int(math.floor(pod.request * cell.full_memory))
+        reserve_resource(cell, pod.request, pod.memory)
+        offset = self.ports[node_name].find_next_and_set()
+        if offset < 0:
+            reclaim_resource(cell, pod.request, pod.memory)
+            raise Unschedulable(f"node {node_name} port pool exhausted")
+        pod.port = C.POD_MANAGER_PORT_START + offset
+        return Binding(pod.key, node_name, pod.chip_ids, [cell.id],
+                       [cell.cell_type], pod.memory, pod.port)
+
+    def unreserve(self, pod: PodRequest) -> list[str]:
+        """Roll back a reservation; returns group members that should be
+        rejected with it (Unreserve, scheduler.go:534-549)."""
+        self._reclaim(pod)
+        if not pod.group_name:
+            return []
+        return [p.key for p in self._group_members(pod) if p.key != pod.key]
+
+    def permit(self, pod: PodRequest) -> tuple[str, float]:
+        """Gang barrier: ``("allow", 0)`` when enough members are bound,
+        else ``("wait", timeout_s)`` (Permit, scheduler.go:551-587)."""
+        group = self.group_of(pod)
+        if not group.key:
+            return "allow", 0.0
+        bound = sum(1 for p in self._group_members(pod)
+                    if p.node_name and p.key != pod.key)
+        if bound + 1 < group.min_available:
+            return "wait", self.permit_wait_base_s * group.headcount
+        return "allow", 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _reclaim(self, pod: PodRequest) -> None:
+        if pod.multi_chip:
+            for cell in pod.cells:
+                reclaim_resource(cell, cell.leaf_cell_number, cell.full_memory)
+        elif pod.cells:
+            reclaim_resource(pod.cells[0], pod.request, pod.memory)
+        if pod.port:
+            self.ports[pod.node_name].unmask(
+                pod.port - C.POD_MANAGER_PORT_START)
+            pod.port = 0
+        pod.cells = []
+        pod.chip_ids = []
+        pod.node_name = ""
+
+    def delete_pod(self, pod_key: str) -> None:
+        """Reclaim a finished/removed workload (deletePod, pod.go:91-136)."""
+        pod = self.pod_status.pop(pod_key, None)
+        if pod is None:
+            return
+        self._reclaim(pod)
+        if pod.group_name and not any(
+                p.group_name == pod.group_name
+                and p.namespace == pod.namespace
+                for p in self.pod_status.values()):
+            self.groups.mark_expired(pod.group_key)
+
+    def resync_bound(self, namespace: str, name: str, labels: dict,
+                     annotations: dict, node_name: str,
+                     uid: str = "") -> PodRequest:
+        """Re-book an already-bound workload after an engine restart from
+        the annotations written at reserve time (processBoundPod/
+        setPodStatus, pod.go:547-617) — state reconstruction without any
+        persisted store."""
+        pod = parse_pod_labels(namespace, name, labels, uid=uid,
+                               node_name=node_name)
+        self.pod_status[pod.key] = pod
+        self.groups.get_or_create(pod)
+        memory = int(annotations.get(C.POD_TPU_MEMORY, "0") or 0)
+        chip_ids = [c for c in
+                    annotations.get(C.POD_TPU_CHIP_ID, "").split(",") if c]
+        cells = []
+        for chip_id in chip_ids:
+            cell = self.leaf_cells.get(chip_id)
+            if cell is None:
+                log.warning("resync %s: chip %s not in topology",
+                            pod.key, chip_id)
+                continue
+            cells.append(cell)
+            if pod.multi_chip:
+                reserve_resource(cell, cell.leaf_cell_number, cell.full_memory)
+            else:
+                reserve_resource(cell, pod.request, memory)
+        pod.cells = cells
+        pod.chip_ids = [c.chip_id for c in cells]
+        pod.memory = memory
+        port = int(annotations.get(C.POD_MANAGER_PORT, "0") or 0)
+        if (C.POD_MANAGER_PORT_START <= port
+                < C.POD_MANAGER_PORT_START + C.POD_MANAGER_PORT_RANGE
+                and node_name in self.ports):
+            self.ports[node_name].mask(port - C.POD_MANAGER_PORT_START)
+            pod.port = port
+        elif port:
+            log.warning("resync %s: port %d outside the pool, ignored",
+                        pod.key, port)
+        return pod
+
+    # -- one full scheduling cycle (the framework loop, for tests/sim) -----
+
+    def schedule(self, pod: PodRequest,
+                 nodes: list[str] | None = None) -> Binding:
+        ok, msg = self.pre_filter(pod)
+        if not ok:
+            raise Unschedulable(f"{pod.key}: {msg}")
+        candidates = []
+        for node in (nodes if nodes is not None else self.nodes):
+            fit, why = self.filter(pod, node)
+            if fit:
+                candidates.append(node)
+            else:
+                log.debug("filter: %s rejected %s: %s", node, pod.key, why)
+        if not candidates:
+            raise Unschedulable(f"{pod.key}: no node passed filtering")
+        raw = {node: self.score(pod, node) for node in candidates}
+        norm = self.normalize_scores(raw)
+        best = max(candidates, key=lambda n: (norm[n], n))
+        return self.reserve(pod, best)

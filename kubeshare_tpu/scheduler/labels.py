@@ -1,0 +1,185 @@
+"""Workload label parsing and validation.
+
+Re-design of the reference's ``getPodLabels``/``getPodPrioriy``/
+``getPodGroupLabels`` (``pkg/scheduler/pod.go:179-327``,
+``pod_group.go:86-117``) over the ``sharedtpu/`` vocabulary
+(:mod:`..constants`). The same three outcomes: a workload needs TPU and is
+well-formed; it needs TPU but is mis-labelled (rejected with a message); or
+it carries no TPU labels at all (a *regular* workload the engine scores but
+never books).
+
+Validation rules (reference parity, deviations noted):
+
+- ``priority``: absent → 0 (opportunistic). Integer in [-1, 100]; ≤ 0 is
+  opportunistic, 1-100 guarantee.
+- ``tpu_limit``: required whenever any TPU label is present; decimal
+  number ≥ 0.
+- ``tpu_request``: optional (default 0); ``request <= limit``; when
+  ``limit > 1`` the pod asks whole chips, so ``limit == request`` AND the
+  value must be an integer — the reference documents the integer rule but
+  only enforces ``limit == request`` (``pod.go:255-262``); we enforce what
+  it documents.
+- ``limit == request == 0`` → regular workload.
+- ``tpu_mem``: optional integer ≥ 0 (bytes).
+- ``tpu_model``: optional free-form chip model.
+- group: all three of ``group_name``/``group_headcount``/
+  ``group_threshold`` must be present and valid, else the pod is treated
+  as groupless (the reference's silent fallback);
+  ``min_available = floor(threshold * headcount + 0.5)``.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+from .. import constants as C
+
+_NUMBER = re.compile(r"^\d+(\.\d+)?$")
+
+
+class LabelError(ValueError):
+    """A TPU workload with malformed labels (reference outcome 2)."""
+
+
+@dataclass
+class PodRequest:
+    """Parsed per-pod scheduling state (≙ PodStatus, pod.go:219-231)."""
+
+    namespace: str
+    name: str
+    uid: str = ""
+    node_name: str = ""
+
+    needs_tpu: bool = False
+    priority: int = 0
+    request: float = 0.0
+    limit: float = 0.0
+    memory: int = 0
+    model: str = ""
+
+    group_name: str = ""
+    headcount: int = 0
+    threshold: float = 0.0
+    min_available: int = 0
+
+    # assigned at reserve / resync
+    cells: list = field(default_factory=list)
+    chip_ids: list[str] = field(default_factory=list)
+    port: int = 0
+    timestamp: float = 0.0        # first-seen time, set by the engine
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def multi_chip(self) -> bool:
+        return self.request > 1.0
+
+    @property
+    def opportunistic(self) -> bool:
+        return self.priority <= 0
+
+    @property
+    def group_key(self) -> str:
+        return f"{self.namespace}/{self.group_name}" if self.group_name else ""
+
+
+def _parse_priority(labels: dict) -> int:
+    raw = labels.get(C.POD_PRIORITY, "")
+    if raw == "":
+        return 0
+    try:
+        p = int(raw)
+    except ValueError:
+        raise LabelError(f"{C.POD_PRIORITY} must be an integer, got {raw!r}")
+    if p < -1 or p > 100:
+        raise LabelError(f"{C.POD_PRIORITY} out of range [-1, 100]: {p}")
+    return p
+
+
+def _parse_number(labels: dict, key: str) -> float | None:
+    raw = labels.get(key)
+    if raw is None:
+        return None
+    if not _NUMBER.fullmatch(str(raw)):
+        raise LabelError(f"{key} is not a non-negative number: {raw!r}")
+    return float(raw)
+
+
+def parse_group_labels(labels: dict) -> tuple[str, int, float, int]:
+    """``(name, headcount, threshold, min_available)``; all-zero when the
+    pod is groupless or the group labels are malformed (the reference
+    logs and degrades rather than rejecting — ``pod_group.go:86-117``)."""
+    name = labels.get(C.POD_GROUP_NAME, "")
+    if not name:
+        return "", 0, 0.0, 0
+    try:
+        headcount = int(labels.get(C.POD_GROUP_HEADCOUNT, ""))
+    except ValueError:
+        return "", 0, 0.0, 0
+    if headcount < 1:
+        return "", 0, 0.0, 0
+    try:
+        threshold = float(labels.get(C.POD_GROUP_THRESHOLD, ""))
+    except ValueError:
+        return "", 0, 0.0, 0
+    if threshold <= 0:
+        return "", 0, 0.0, 0
+    min_available = int(math.floor(threshold * headcount + 0.5))
+    return name, headcount, threshold, min_available
+
+
+def parse_pod_labels(namespace: str, name: str, labels: dict,
+                     uid: str = "", node_name: str = "") -> PodRequest:
+    """labels → :class:`PodRequest`; raises :class:`LabelError` on
+    malformed TPU labels (``getPodLabels``, pod.go:207-327)."""
+    pr = PodRequest(namespace=namespace, name=name, uid=uid,
+                    node_name=node_name)
+    (pr.group_name, pr.headcount, pr.threshold,
+     pr.min_available) = parse_group_labels(labels)
+    pr.priority = _parse_priority(labels)
+
+    has_any = any(k in labels for k in
+                  (C.POD_TPU_LIMIT, C.POD_TPU_REQUEST, C.POD_TPU_MEMORY))
+    if not has_any:
+        return pr  # regular workload
+
+    limit = _parse_number(labels, C.POD_TPU_LIMIT)
+    if limit is None:
+        raise LabelError(f"{C.POD_TPU_LIMIT} is required for TPU workloads")
+
+    request = _parse_number(labels, C.POD_TPU_REQUEST) or 0.0
+    if request > limit:
+        raise LabelError(f"tpu_request {request} > tpu_limit {limit}")
+    if limit > 1.0:
+        if limit != request:
+            raise LabelError(
+                f"whole-chip workloads need tpu_limit == tpu_request "
+                f"({limit} != {request})")
+        if not float(request).is_integer():
+            raise LabelError(
+                f"whole-chip tpu_request must be an integer, got {request}")
+
+    if limit == 0.0 and request == 0.0:
+        return pr  # regular workload after all
+
+    raw_mem = labels.get(C.POD_TPU_MEMORY)
+    memory = 0
+    if raw_mem is not None:
+        try:
+            memory = int(raw_mem)
+        except ValueError:
+            raise LabelError(f"{C.POD_TPU_MEMORY} must be an integer byte "
+                             f"count: {raw_mem!r}")
+        if memory < 0:
+            raise LabelError(f"{C.POD_TPU_MEMORY} must be >= 0: {memory}")
+
+    pr.needs_tpu = True
+    pr.limit = limit
+    pr.request = request
+    pr.memory = memory
+    pr.model = labels.get(C.POD_TPU_MODEL, "")
+    return pr
