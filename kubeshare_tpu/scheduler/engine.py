@@ -127,21 +127,46 @@ class SchedulerEngine:
         hand-written file). Auto-derivation rebuilds the cell trees on
         every new node and re-books live workloads onto the fresh trees —
         the same replay the crash resync performs."""
-        is_new = node_name not in self.chips_by_node
         by_model: dict[str, list[ChipInfo]] = {}
         for chip in chips:
             by_model.setdefault(chip.model, []).append(chip)
+        changed = self.chips_by_node.get(node_name) != by_model
         self.chips_by_node[node_name] = by_model
         self.node_health[node_name] = healthy
         if node_name not in self.ports:
             bitmap = RRBitmap(C.POD_MANAGER_PORT_RANGE)
             bitmap.mask(0)  # parity: port base is never handed out
             self.ports[node_name] = bitmap
-        if self._auto_config and (is_new or self._config is None):
+        if self._auto_config and (changed or self._config is None):
             self._rebuild_auto_config()
         else:
+            if changed and not self._auto_config:
+                log.warning("node %s inventory changed under an explicit "
+                            "topology config; cells keep the configured "
+                            "shape", node_name)
             set_node_status(self.free_list, self.chips_by_node,
                             self.leaf_cells, node_name, healthy)
+
+    def set_fleet(self, fleet: dict[str, tuple[list[ChipInfo], bool]]) -> None:
+        """Batch inventory update: one rebuild for the whole fleet instead
+        of one per node (the full-sync path)."""
+        for node_name, (chips, healthy) in fleet.items():
+            by_model: dict[str, list[ChipInfo]] = {}
+            for chip in chips:
+                by_model.setdefault(chip.model, []).append(chip)
+            self.chips_by_node[node_name] = by_model
+            self.node_health[node_name] = healthy
+            if node_name not in self.ports:
+                bitmap = RRBitmap(C.POD_MANAGER_PORT_RANGE)
+                bitmap.mask(0)
+                self.ports[node_name] = bitmap
+        if self._auto_config:
+            self._rebuild_auto_config()
+        else:
+            for node_name in fleet:
+                set_node_status(self.free_list, self.chips_by_node,
+                                self.leaf_cells, node_name,
+                                self.node_health[node_name])
 
     def _rebuild_auto_config(self) -> None:
         all_chips = [c for models in self.chips_by_node.values()
@@ -151,20 +176,17 @@ class SchedulerEngine:
         for node, healthy in self.node_health.items():
             set_node_status(self.free_list, self.chips_by_node,
                             self.leaf_cells, node, healthy)
-        # replay live bookings onto the fresh trees (ports stay masked —
-        # the bitmaps are per-node state, untouched by the rebuild)
+        # replay live bookings onto the fresh trees, amount-exact (ports
+        # stay masked — the bitmaps are per-node state, untouched)
         for pod in self.pod_status.values():
-            if not pod.chip_ids:
+            if not pod.bookings:
                 continue
-            cells = [self.leaf_cells[cid] for cid in pod.chip_ids
-                     if cid in self.leaf_cells]
-            pod.cells = cells
-            for cell in cells:
-                if pod.multi_chip:
-                    reserve_resource(cell, cell.leaf_cell_number,
-                                     cell.full_memory)
-                else:
-                    reserve_resource(cell, pod.request, pod.memory)
+            pod.cells = [self.leaf_cells[cid] for cid, _, _ in pod.bookings
+                         if cid in self.leaf_cells]
+            for chip_id, compute, memory in pod.bookings:
+                cell = self.leaf_cells.get(chip_id)
+                if cell is not None:
+                    reserve_resource(cell, compute, memory)
 
     def set_node_health(self, node_name: str, healthy: bool) -> None:
         self.node_health[node_name] = healthy
@@ -254,9 +276,17 @@ class SchedulerEngine:
         for model in models:
             fit, cur_avail, cur_mem = filter_node(
                 self.free_list, node_name, model, pod.request, pod.memory)
+            if fit:
+                return True, ""
+            if pod.multi_chip:
+                # A multi-chip gang is one mesh workload: it cannot span
+                # chip generations, so never sum availability across
+                # models (the reference does, scheduler.go:395-404 — a
+                # wrong fit for mixed-model nodes).
+                continue
             available += cur_avail
             free_mem += cur_mem
-            if fit or (available >= pod.request and free_mem >= pod.memory):
+            if available >= pod.request and free_mem >= pod.memory:
                 return True, ""
         return False, f"node {node_name} cannot fit {pod.request}"
 
@@ -289,9 +319,13 @@ class SchedulerEngine:
         pod.cells = cells
         pod.chip_ids = [c.chip_id for c in cells]
         if pod.multi_chip:
-            # whole leaves: book everything they have (pod.go:360-366)
+            # whole leaves: book everything they have (pod.go:360-366),
+            # recording the exact amounts — free memory at bind time, not
+            # full memory — so reclaim can mirror them.
             memory = 0
             for cell in cells:
+                pod.bookings.append(
+                    (cell.chip_id, cell.available, cell.free_memory))
                 memory += cell.free_memory
                 reserve_resource(cell, cell.available, cell.free_memory)
             pod.memory = memory
@@ -303,11 +337,16 @@ class SchedulerEngine:
             # default the HBM cap to the compute fraction of the chip
             # (pod.go:419-424)
             pod.memory = int(math.floor(pod.request * cell.full_memory))
-        reserve_resource(cell, pod.request, pod.memory)
         offset = self.ports[node_name].find_next_and_set()
         if offset < 0:
-            reclaim_resource(cell, pod.request, pod.memory)
+            # roll the assignment back completely — a half-populated pod
+            # would double-reclaim on the framework's unreserve call
+            pod.cells = []
+            pod.chip_ids = []
+            pod.node_name = ""
             raise Unschedulable(f"node {node_name} port pool exhausted")
+        reserve_resource(cell, pod.request, pod.memory)
+        pod.bookings.append((cell.chip_id, pod.request, pod.memory))
         pod.port = C.POD_MANAGER_PORT_START + offset
         return Binding(pod.key, node_name, pod.chip_ids, [cell.id],
                        [cell.cell_type], pod.memory, pod.port)
@@ -335,11 +374,15 @@ class SchedulerEngine:
     # -- lifecycle ---------------------------------------------------------
 
     def _reclaim(self, pod: PodRequest) -> None:
-        if pod.multi_chip:
-            for cell in pod.cells:
-                reclaim_resource(cell, cell.leaf_cell_number, cell.full_memory)
-        elif pod.cells:
-            reclaim_resource(pod.cells[0], pod.request, pod.memory)
+        # Reclaim exactly what reserve/resync booked — the recorded
+        # amounts, not re-derived ones (a multi-chip leaf's free memory at
+        # bind time is not its full memory when a fraction already lived
+        # there).
+        for chip_id, compute, memory in pod.bookings:
+            cell = self.leaf_cells.get(chip_id)
+            if cell is not None:
+                reclaim_resource(cell, compute, memory)
+        pod.bookings = []
         if pod.port:
             self.ports[pod.node_name].unmask(
                 pod.port - C.POD_MANAGER_PORT_START)
@@ -369,6 +412,7 @@ class SchedulerEngine:
         persisted store."""
         pod = parse_pod_labels(namespace, name, labels, uid=uid,
                                node_name=node_name)
+        pod.timestamp = self._clock()
         self.pod_status[pod.key] = pod
         self.groups.get_or_create(pod)
         memory = int(annotations.get(C.POD_TPU_MEMORY, "0") or 0)
@@ -383,9 +427,11 @@ class SchedulerEngine:
                 continue
             cells.append(cell)
             if pod.multi_chip:
-                reserve_resource(cell, cell.leaf_cell_number, cell.full_memory)
+                booked = (cell.leaf_cell_number, cell.full_memory)
             else:
-                reserve_resource(cell, pod.request, memory)
+                booked = (pod.request, memory)
+            pod.bookings.append((chip_id, *booked))
+            reserve_resource(cell, *booked)
         pod.cells = cells
         pod.chip_ids = [c.chip_id for c in cells]
         pod.memory = memory

@@ -67,6 +67,10 @@ class PodRequest:
     # assigned at reserve / resync
     cells: list = field(default_factory=list)
     chip_ids: list[str] = field(default_factory=list)
+    #: exact amounts booked, as (chip_id, compute, memory_bytes) — reclaim
+    #: must mirror what reserve actually booked (a multi-chip pod books the
+    #: leaf's *free* memory at bind time, not its full memory)
+    bookings: list[tuple[str, float, int]] = field(default_factory=list)
     port: int = 0
     timestamp: float = 0.0        # first-seen time, set by the engine
 

@@ -116,6 +116,18 @@ def select_cells(free_list, node_name: str, pod: PodRequest,
                  mesh_shape: tuple[int, ...] | None = None) -> list[Cell]:
     """Reserve-time leaf choice (score.go:297-442). Returns [] when the
     node can no longer fit the pod (raced capacity)."""
+    if pod.multi_chip and not pod.model:
+        # One mesh workload never spans chip generations: try each model's
+        # leaves separately, best-priority model first.
+        models = sorted(free_list,
+                        key=lambda m: -chip_priority.get(m, 0))
+        for model in models:
+            constrained = PodRequest(**{**pod.__dict__, "model": model})
+            chosen = select_cells(free_list, node_name, constrained,
+                                  chip_priority, group_cells, mesh_shape)
+            if chosen:
+                return chosen
+        return []
     leaves = node_leaf_cells(free_list, node_name, pod.model)
     scored: list[tuple[float, Cell]] = []
     for leaf in leaves:

@@ -1,0 +1,18 @@
+"""Telemetry plane: capacity/requirement registry bus + exporters.
+
+Replaces the reference's Prometheus decision loop (its own TODO,
+``README.md:133``) with fresh-read push/pull; keeps Prometheus exposition
+for observability. See :mod:`.registry`, :mod:`.collector`,
+:mod:`.aggregator`.
+"""
+
+from .aggregator import (publish_binding, requirement_record,
+                         sync_engine_from_registry, withdraw)
+from .collector import CapacityCollector
+from .registry import RegistryClient, TelemetryRegistry
+
+__all__ = [
+    "CapacityCollector", "RegistryClient", "TelemetryRegistry",
+    "publish_binding", "requirement_record", "sync_engine_from_registry",
+    "withdraw",
+]

@@ -1,0 +1,232 @@
+"""The telemetry registry — the cluster state bus.
+
+The reference routes *scheduling decisions* through Prometheus: collectors
+export ``gpu_capacity``, the aggregator exports ``gpu_requirement``, and
+both the scheduler and the node daemon query them back over PromQL with a
+5 s scrape + 5-10 s query window (``pkg/scheduler/gpu.go:22-37``,
+``pkg/config/query.go:22-37``). That staleness is the reference's weakest
+link — its own README plans to replace it (``README.md:133``).
+
+This registry is the replacement: collectors PUSH capacity on change,
+the scheduler PUSHES requirement records at bind time, and every consumer
+GETs fresh state — no scrape window in the decision path. Prometheus stays
+for *observability*: ``GET /metrics`` renders both metric families in
+exposition format with the reference's shape (data in labels, value =
+timestamp — ``collector.go:49-58``).
+
+HTTP API (JSON bodies):
+
+- ``PUT  /capacity/<node>``    {"chips": [chip labels...], "healthy": bool}
+- ``GET  /capacity``           {node: {"chips": [...], "healthy", "ts"}}
+- ``DELETE /capacity/<node>``
+- ``PUT  /pods/<ns>/<name>``   requirement record (see aggregator)
+- ``GET  /pods[?node=X]``      {key: record}
+- ``DELETE /pods/<ns>/<name>``
+- ``GET  /metrics``            Prometheus exposition (capacity+requirement)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.logger import get_logger
+
+log = get_logger("registry")
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def render_metric(name: str, labels: dict, value: float) -> str:
+    inner = ",".join(f'{k}="{_prom_escape(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}} {value}"
+
+
+class TelemetryRegistry:
+    """In-memory cluster state with an HTTP surface."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._capacity: dict[str, dict] = {}
+        self._pods: dict[str, dict] = {}
+        self._server: ThreadingHTTPServer | None = None
+
+    # -- state (thread-safe, also usable in-process) -----------------------
+
+    def put_capacity(self, node: str, chips: list[dict],
+                     healthy: bool = True) -> None:
+        with self._lock:
+            self._capacity[node] = {"chips": chips, "healthy": healthy,
+                                    "ts": time.time()}
+
+    def drop_capacity(self, node: str) -> None:
+        with self._lock:
+            self._capacity.pop(node, None)
+
+    def capacity(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._capacity.items()}
+
+    def put_pod(self, key: str, record: dict) -> None:
+        with self._lock:
+            self._pods[key] = dict(record, ts=time.time())
+
+    def drop_pod(self, key: str) -> None:
+        with self._lock:
+            self._pods.pop(key, None)
+
+    def pods(self, node: str | None = None) -> dict[str, dict]:
+        with self._lock:
+            items = dict(self._pods)
+        if node is None:
+            return items
+        return {k: v for k, v in items.items() if v.get("node") == node}
+
+    def render_metrics(self) -> str:
+        """Prometheus exposition, reference metric shapes
+        (collector.go:30-35, aggregator.go:22-39) under TPU names."""
+        lines = ["# TYPE tpu_capacity gauge"]
+        for node, entry in self.capacity().items():
+            for chip in entry["chips"]:
+                lines.append(render_metric("tpu_capacity", chip, entry["ts"]))
+        lines.append("# TYPE tpu_requirement gauge")
+        for key, rec in self.pods().items():
+            labels = {k: v for k, v in rec.items() if k != "ts"}
+            ns, _, name = key.partition("/")
+            labels.update({"namespace": ns, "pod": name})
+            lines.append(render_metric("tpu_requirement", labels, rec["ts"]))
+        return "\n".join(lines) + "\n"
+
+    # -- HTTP server -------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1",
+              port: int = 0) -> ThreadingHTTPServer:
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route into our logger
+                log.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj) -> None:
+                self._reply(200, json.dumps(obj).encode())
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/capacity":
+                    return self._json(registry.capacity())
+                if path == "/pods":
+                    node = None
+                    if "?" in self.path:
+                        from urllib.parse import parse_qs
+                        qs = parse_qs(self.path.split("?", 1)[1])
+                        node = (qs.get("node") or [None])[0]
+                    return self._json(registry.pods(node))
+                if path == "/metrics":
+                    return self._reply(200, registry.render_metrics().encode(),
+                                       "text/plain; version=0.0.4")
+                self._reply(404, b"{}")
+
+            def do_PUT(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] == "capacity":
+                    body = self._body()
+                    registry.put_capacity(parts[1], body.get("chips", []),
+                                          bool(body.get("healthy", True)))
+                    return self._json({"ok": True})
+                if len(parts) == 3 and parts[0] == "pods":
+                    registry.put_pod(f"{parts[1]}/{parts[2]}", self._body())
+                    return self._json({"ok": True})
+                self._reply(404, b"{}")
+
+            do_POST = do_PUT
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] == "capacity":
+                    registry.drop_capacity(parts[1])
+                    return self._json({"ok": True})
+                if len(parts) == 3 and parts[0] == "pods":
+                    registry.drop_pod(f"{parts[1]}/{parts[2]}")
+                    return self._json({"ok": True})
+                self._reply(404, b"{}")
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True,
+                         name="telemetry-registry").start()
+        self._server = server
+        log.info("telemetry registry on %s:%d", *server.server_address[:2])
+        return server
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class RegistryClient:
+    """Thin HTTP client for the registry."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self._base = f"http://{host}:{port}"
+        self._timeout = timeout
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(self._base + path, data=data,
+                                     method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    def put_capacity(self, node: str, chips: list[dict],
+                     healthy: bool = True) -> None:
+        self._request("PUT", f"/capacity/{node}",
+                      {"chips": chips, "healthy": healthy})
+
+    def capacity(self) -> dict[str, dict]:
+        return self._request("GET", "/capacity")
+
+    def drop_capacity(self, node: str) -> None:
+        self._request("DELETE", f"/capacity/{node}")
+
+    def put_pod(self, key: str, record: dict) -> None:
+        self._request("PUT", f"/pods/{key}", record)
+
+    def pods(self, node: str | None = None) -> dict[str, dict]:
+        path = "/pods" if node is None else f"/pods?node={node}"
+        return self._request("GET", path)
+
+    def drop_pod(self, key: str) -> None:
+        self._request("DELETE", f"/pods/{key}")
+
+    def metrics(self) -> str:
+        req = urllib.request.Request(self._base + "/metrics")
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            return resp.read().decode()

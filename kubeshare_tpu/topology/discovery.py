@@ -111,7 +111,15 @@ def discover_chips(backend: str = "auto", host: str | None = None,
     if backend == "fake":
         if fake is None:
             fake = parse_fake_spec(os.environ.get("KUBESHARE_TPU_FAKE_TOPOLOGY", "1:2x2"))
-        return fake.chips()
+        chips = fake.chips()
+        if host is not None:
+            # A per-node collector must report only its own chips — the
+            # fleet-wide fake spec is a test convenience, not this node's
+            # inventory.
+            mine = [c for c in chips if c.host == host]
+            if mine:
+                return mine
+        return chips
     raise ValueError(f"unknown discovery backend: {backend}")
 
 

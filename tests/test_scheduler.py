@@ -396,6 +396,56 @@ def test_resync_ignores_out_of_pool_port():
     assert pod.cells and pod.cells[0].available == 0.5
 
 
+def test_mixed_booking_reclaim_is_exact():
+    """A multi-chip pod books a leaf's *free* memory; its reclaim must
+    mirror that, not the full memory (drift regression)."""
+    eng = engine_with(hosts=1, mesh=(2,))
+    frac = eng.submit("ns", "frac", {
+        C.POD_TPU_REQUEST: "0", C.POD_TPU_LIMIT: "0.5",
+        C.POD_TPU_MEMORY: str(HBM // 4)})
+    eng.schedule(frac)  # request 0: leaf stays whole-free, memory booked
+    big = eng.submit("ns", "big", shared_labels("2", "2"))
+    eng.schedule(big)
+    eng.delete_pod("ns/big")
+    eng.delete_pod("ns/frac")
+    for leaf in eng.leaf_cells.values():
+        assert leaf.free_memory == HBM and leaf.available == 1.0
+
+
+def test_multichip_never_spans_models():
+    eng = SchedulerEngine()
+    chips = (FakeTopology(hosts=1, mesh=(2,), model="TPU-v4").chips()
+             + FakeTopology(hosts=1, mesh=(2,), model="TPU-v5e").chips())
+    eng.add_node("tpu-host-0", chips)
+    pod = eng.submit("ns", "big", shared_labels("4", "4"))
+    with pytest.raises(Unschedulable):
+        eng.schedule(pod)  # 4 chips exist, but 2+2 across generations
+    pod2 = eng.submit("ns", "pair", shared_labels("2", "2"))
+    binding = eng.schedule(pod2)
+    assert len(set(binding.models)) == 1
+
+
+def test_inventory_change_rebuilds_auto_topology():
+    eng = engine_with(hosts=1, mesh=(1,))
+    eng.schedule(eng.submit("ns", "p", shared_labels("0.5", "1.0")))
+    grown = FakeTopology(hosts=1, mesh=(2,)).chips()
+    eng.add_node("tpu-host-0", grown)
+    assert len(eng.leaf_cells) == 2  # new chip became schedulable
+    booked = eng.leaf_cells["TPU-v4-tpu-host-0-0"]
+    assert booked.available == 0.5  # live booking replayed
+
+
+def test_set_fleet_batch_build():
+    eng = SchedulerEngine()
+    topo = FakeTopology(hosts=3, mesh=(2,))
+    fleet: dict = {}
+    for chip in topo.chips():
+        fleet.setdefault(chip.host, ([], True))[0].append(chip)
+    eng.set_fleet(fleet)
+    assert len(eng.leaf_cells) == 6
+    assert len(eng.nodes) == 3
+
+
 def test_port_pool_round_robin_reuse():
     eng = engine_with(hosts=1, mesh=(1,))
     b1 = eng.schedule(eng.submit("ns", "a", shared_labels("0.3", "1.0")))
