@@ -486,23 +486,45 @@ def main(argv=None) -> None:
 
     from ..constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
 
+    from .tokensched import serve as serve_tokens
+
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.isolation.proxy")
     parser.add_argument("-P", "--port", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("-q", "--base-quota", type=float, default=BASE_QUOTA_MS)
     parser.add_argument("-m", "--min-quota", type=float, default=MIN_QUOTA_MS)
     parser.add_argument("-w", "--window", type=float, default=WINDOW_MS)
+    parser.add_argument("-S", "--token-port", type=int, default=-1,
+                        help="also serve the token scheduler over TCP for "
+                             "pod managers (gem-schd parity); -1 = off, "
+                             "0 = ephemeral")
+    parser.add_argument("--platform", default="",
+                        help="force a JAX platform (e.g. 'cpu'); needed "
+                             "because the image config pins the platform "
+                             "list regardless of JAX_PLATFORMS")
     args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
 
     sched = TokenScheduler(window_ms=args.window, base_quota_ms=args.base_quota,
                            min_quota_ms=args.min_quota)
     proxy = ChipProxy(scheduler=sched)
     server = proxy.serve(args.host, args.port)
-    print(f"READY {server.server_address[1]}", flush=True)
+    token_server = None
+    token_port = ""
+    if args.token_port >= 0:
+        token_server = serve_tokens(sched, args.host, args.token_port)
+        token_port = f" TOKENS {token_server.server_address[1]}"
+    print(f"READY {server.server_address[1]}{token_port}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if token_server is not None:
+        token_server.shutdown()
+        token_server.server_close()
     proxy.close()
 
 

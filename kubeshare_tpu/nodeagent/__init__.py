@@ -1,0 +1,17 @@
+"""Node actuation: registry → per-chip files → process lifecycle.
+
+Parity with the reference's L3 (``pkg/config`` daemon) and the gemini
+launcher container (``launcher-multigpus.sh`` + ``launcher.py``); see
+:mod:`.configd`, :mod:`.launcherd`, :mod:`.files`, :mod:`.queryip`.
+"""
+
+from .configd import ConfigDaemon, records_to_entries
+from .files import ClientEntry, read_chip_clients, write_chip_clients
+from .launcherd import LauncherDaemon
+from .queryip import read_scheduler_ip, write_scheduler_ip
+
+__all__ = [
+    "ClientEntry", "ConfigDaemon", "LauncherDaemon", "records_to_entries",
+    "read_chip_clients", "read_scheduler_ip", "write_chip_clients",
+    "write_scheduler_ip",
+]

@@ -127,10 +127,11 @@ class SchedulerEngine:
         hand-written file). Auto-derivation rebuilds the cell trees on
         every new node and re-books live workloads onto the fresh trees —
         the same replay the crash resync performs."""
+        known = node_name in self.chips_by_node
         by_model: dict[str, list[ChipInfo]] = {}
         for chip in chips:
             by_model.setdefault(chip.model, []).append(chip)
-        changed = self.chips_by_node.get(node_name) != by_model
+        changed = not known or self.chips_by_node[node_name] != by_model
         self.chips_by_node[node_name] = by_model
         self.node_health[node_name] = healthy
         if node_name not in self.ports:
@@ -140,7 +141,7 @@ class SchedulerEngine:
         if self._auto_config and (changed or self._config is None):
             self._rebuild_auto_config()
         else:
-            if changed and not self._auto_config:
+            if known and changed and not self._auto_config:
                 log.warning("node %s inventory changed under an explicit "
                             "topology config; cells keep the configured "
                             "shape", node_name)
@@ -149,7 +150,13 @@ class SchedulerEngine:
 
     def set_fleet(self, fleet: dict[str, tuple[list[ChipInfo], bool]]) -> None:
         """Batch inventory update: one rebuild for the whole fleet instead
-        of one per node (the full-sync path)."""
+        of one per node (the full-sync path). Nodes absent from *fleet*
+        are removed — a departed collector's capacity must not stay
+        schedulable (port bitmaps are kept so masks survive a flap)."""
+        for gone in set(self.chips_by_node) - set(fleet):
+            del self.chips_by_node[gone]
+            self.node_health.pop(gone, None)
+            log.info("node %s left the fleet", gone)
         for node_name, (chips, healthy) in fleet.items():
             by_model: dict[str, list[ChipInfo]] = {}
             for chip in chips:
@@ -271,22 +278,14 @@ class SchedulerEngine:
                                     pod.request, pod.memory)
             return (fit, "" if fit else
                     f"node {node_name} cannot fit {pod.request}")
-        available = 0.0
-        free_mem = 0
+        # Per-model fit only — never summed across models. For multi-chip
+        # pods a cross-model sum would admit a mesh workload spanning chip
+        # generations (the reference's bug, scheduler.go:395-404); for
+        # shared pods the sum is meaningless anyway (one leaf must fit).
         for model in models:
-            fit, cur_avail, cur_mem = filter_node(
+            fit, _, _ = filter_node(
                 self.free_list, node_name, model, pod.request, pod.memory)
             if fit:
-                return True, ""
-            if pod.multi_chip:
-                # A multi-chip gang is one mesh workload: it cannot span
-                # chip generations, so never sum availability across
-                # models (the reference does, scheduler.go:395-404 — a
-                # wrong fit for mixed-model nodes).
-                continue
-            available += cur_avail
-            free_mem += cur_mem
-            if available >= pod.request and free_mem >= pod.memory:
                 return True, ""
         return False, f"node {node_name} cannot fit {pod.request}"
 
@@ -333,17 +332,22 @@ class SchedulerEngine:
                            [c.id for c in cells],
                            [c.cell_type for c in cells], memory)
         cell = cells[0]
-        if pod.memory == 0:
+        memory_defaulted = pod.memory == 0
+        if memory_defaulted:
             # default the HBM cap to the compute fraction of the chip
             # (pod.go:419-424)
             pod.memory = int(math.floor(pod.request * cell.full_memory))
         offset = self.ports[node_name].find_next_and_set()
         if offset < 0:
             # roll the assignment back completely — a half-populated pod
-            # would double-reclaim on the framework's unreserve call
+            # would double-reclaim on the framework's unreserve call, and
+            # a kept default cap would carry this chip's HBM size to the
+            # retry on a different chip generation
             pod.cells = []
             pod.chip_ids = []
             pod.node_name = ""
+            if memory_defaulted:
+                pod.memory = 0
             raise Unschedulable(f"node {node_name} port pool exhausted")
         reserve_resource(cell, pod.request, pod.memory)
         pod.bookings.append((cell.chip_id, pod.request, pod.memory))

@@ -48,16 +48,26 @@ class CapacityCollector:
         self.last_chips: list = []
 
     def collect_once(self) -> bool:
-        """One discovery + push; returns health."""
+        """One discovery + push; returns health. Registry errors are
+        logged, not raised — the next period retries (an unreachable
+        registry must not kill the loop and leave the node's entry
+        permanently stale)."""
         try:
             chips = discover_chips(self.backend, host=self.node)
         except Exception as e:
             log.error("chip discovery failed: %s", e)
-            self.registry.put_capacity(self.node, [], healthy=False)
+            try:
+                self.registry.put_capacity(self.node, [], healthy=False)
+            except Exception as push_err:
+                log.error("capacity push failed: %s", push_err)
             return False
         self.last_chips = chips
-        self.registry.put_capacity(
-            self.node, [c.to_labels() for c in chips], healthy=True)
+        try:
+            self.registry.put_capacity(
+                self.node, [c.to_labels() for c in chips], healthy=True)
+        except Exception as e:
+            log.error("capacity push failed: %s", e)
+            return False
         return True
 
     def run_forever(self) -> None:

@@ -113,12 +113,11 @@ def discover_chips(backend: str = "auto", host: str | None = None,
             fake = parse_fake_spec(os.environ.get("KUBESHARE_TPU_FAKE_TOPOLOGY", "1:2x2"))
         chips = fake.chips()
         if host is not None:
-            # A per-node collector must report only its own chips — the
-            # fleet-wide fake spec is a test convenience, not this node's
-            # inventory.
-            mine = [c for c in chips if c.host == host]
-            if mine:
-                return mine
+            # A per-node collector must report only its own chips — a
+            # host outside the fake fleet's namespace reports none (a
+            # whole-fleet fallback would make every collector publish
+            # every chip as its own).
+            return [c for c in chips if c.host == host]
         return chips
     raise ValueError(f"unknown discovery backend: {backend}")
 

@@ -1,0 +1,200 @@
+"""Node actuation tests: files, config daemon, launcher lifecycle.
+
+The lifecycle integration (add/remove a client entry spawns/kills its
+manager process) is the test the reference only had as a manual harness
+(``launch-backend.py``, SURVEY §4).
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubeshare_tpu import constants as C
+from kubeshare_tpu.nodeagent import (ClientEntry, ConfigDaemon,
+                                     LauncherDaemon, read_chip_clients,
+                                     read_scheduler_ip, records_to_entries,
+                                     write_chip_clients, write_scheduler_ip)
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.telemetry import (TelemetryRegistry, publish_binding,
+                                     sync_engine_from_registry, withdraw)
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+CHIP = "TPU-v4-tpu-host-0-0"
+
+
+def entry(name="ns/p", request=0.5, limit=1.0, memory=0, port=50051):
+    return ClientEntry(name, request, limit, memory, port)
+
+
+# --------------------------------------------------------------------------
+# files
+# --------------------------------------------------------------------------
+
+def test_chip_files_roundtrip(tmp_path):
+    base = str(tmp_path)
+    clients = [entry("ns/a", port=50051), entry("ns/b", 0.3, 0.5, 1024, 50052)]
+    config_path, port_path = write_chip_clients(CHIP, clients, base)
+    assert os.path.exists(config_path) and os.path.exists(port_path)
+    assert read_chip_clients(CHIP, base) == clients
+    # zero-fill cleanup keeps the files, empties the lists
+    write_chip_clients(CHIP, [], base)
+    assert read_chip_clients(CHIP, base) == []
+
+
+def test_records_to_entries_filters_whole_chip():
+    records = {
+        "ns/shared": {"chip_id": CHIP, "request": "0.5", "limit": "1.0",
+                      "memory": "0", "port": "50051"},
+        "ns/whole": {"chip_id": CHIP, "request": "2", "limit": "2",
+                     "memory": "0", "port": "0"},
+        "ns/bad": {"chip_id": CHIP, "request": "x", "limit": "y"},
+    }
+    by_chip = records_to_entries(records)
+    assert [e.name for e in by_chip[CHIP]] == ["ns/shared"]
+
+
+def test_query_ip_roundtrip(tmp_path):
+    path = str(tmp_path / "schedulerIP.txt")
+    write_scheduler_ip("10.0.0.7", 9004, path)
+    assert read_scheduler_ip(path) == ("10.0.0.7", 9004)
+
+
+# --------------------------------------------------------------------------
+# config daemon: registry → files
+# --------------------------------------------------------------------------
+
+def test_configd_writes_and_zero_fills(tmp_path):
+    registry = TelemetryRegistry()  # in-process, no HTTP needed here
+    base = str(tmp_path)
+    daemon = ConfigDaemon(registry, "tpu-host-0", [CHIP], base_dir=base)
+
+    registry.put_pod("ns/p", {"node": "tpu-host-0", "chip_id": CHIP,
+                              "request": "0.5", "limit": "1.0",
+                              "memory": "128", "port": "50051"})
+    assert daemon.sync_once() == [CHIP]
+    clients = read_chip_clients(CHIP, base)
+    assert clients == [ClientEntry("ns/p", 0.5, 1.0, 128, 50051)]
+    assert daemon.sync_once() == []  # unchanged → no rewrite
+
+    registry.drop_pod("ns/p")
+    assert daemon.sync_once() == [CHIP]
+    assert read_chip_clients(CHIP, base) == []
+
+
+def test_configd_ignores_other_nodes(tmp_path):
+    registry = TelemetryRegistry()
+    daemon = ConfigDaemon(registry, "tpu-host-0", [CHIP],
+                          base_dir=str(tmp_path))
+    registry.put_pod("ns/other", {"node": "elsewhere", "chip_id": CHIP,
+                                  "request": "0.5", "limit": "1.0",
+                                  "memory": "0", "port": "50051"})
+    daemon.sync_once()
+    assert read_chip_clients(CHIP, str(tmp_path)) == []
+
+
+# --------------------------------------------------------------------------
+# launcher daemon: files → processes
+# --------------------------------------------------------------------------
+
+def stub_cmd(*_args, **_kw):
+    """A manager that just sleeps — lifecycle is what's under test."""
+    return [sys.executable, "-c", "import time; time.sleep(60)"], dict(os.environ)
+
+
+@pytest.fixture
+def launcher(tmp_path):
+    daemon = LauncherDaemon([CHIP], base_dir=str(tmp_path), poll_s=0.05,
+                            proxy_cmd=stub_cmd, pmgr_cmd=stub_cmd,
+                            spawn_proxies=False)
+    yield daemon, str(tmp_path)
+    daemon.stop()
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_launcher_spawns_and_kills_managers(launcher):
+    daemon, base = launcher
+    write_chip_clients(CHIP, [entry("ns/a", port=50051)], base)
+    daemon.start()
+    assert wait_for(lambda: (CHIP, "ns/a") in daemon._managers)
+    _, proc = daemon._managers[(CHIP, "ns/a")]
+    assert proc.poll() is None
+
+    # second client joins
+    write_chip_clients(CHIP, [entry("ns/a", port=50051),
+                              entry("ns/b", port=50052)], base)
+    assert wait_for(lambda: (CHIP, "ns/b") in daemon._managers)
+
+    # first client leaves → its manager must die (launcher.py:58-66)
+    write_chip_clients(CHIP, [entry("ns/b", port=50052)], base)
+    assert wait_for(lambda: (CHIP, "ns/a") not in daemon._managers)
+    assert wait_for(lambda: proc.poll() is not None)
+
+
+def test_launcher_restarts_dead_manager(launcher):
+    daemon, base = launcher
+    write_chip_clients(CHIP, [entry("ns/a", port=50051)], base)
+    daemon.start()
+    assert wait_for(lambda: (CHIP, "ns/a") in daemon._managers)
+    _, proc = daemon._managers[(CHIP, "ns/a")]
+    proc.terminate()
+    assert wait_for(
+        lambda: daemon._managers.get((CHIP, "ns/a"), (0, proc))[1] is not proc)
+
+
+def test_launcher_port_change_restarts_manager(launcher):
+    daemon, base = launcher
+    write_chip_clients(CHIP, [entry("ns/a", port=50051)], base)
+    daemon.start()
+    assert wait_for(lambda: (CHIP, "ns/a") in daemon._managers)
+    write_chip_clients(CHIP, [entry("ns/a", port=50099)], base)
+    assert wait_for(
+        lambda: daemon._managers.get((CHIP, "ns/a"), (0, None))[0] == 50099)
+
+
+# --------------------------------------------------------------------------
+# the full control loop: scheduler → registry → configd → launcherd
+# --------------------------------------------------------------------------
+
+def test_end_to_end_control_loop(tmp_path):
+    registry = TelemetryRegistry()
+    chips = FakeTopology(hosts=1, mesh=(1,)).chips()
+    registry.put_capacity("tpu-host-0", [c.to_labels() for c in chips])
+
+    eng = SchedulerEngine()
+    sync_engine_from_registry(eng, registry)
+    pod = eng.submit("ns", "mnist", {C.POD_TPU_REQUEST: "0.5",
+                                     C.POD_TPU_LIMIT: "1.0"})
+    binding = eng.schedule(pod)
+    publish_binding(registry, pod, binding)
+
+    base = str(tmp_path)
+    configd = ConfigDaemon(registry, "tpu-host-0",
+                           [c.chip_id for c in chips], base_dir=base,
+                           period_s=0.05)
+    launcherd = LauncherDaemon([c.chip_id for c in chips], base_dir=base,
+                               poll_s=0.05, proxy_cmd=stub_cmd,
+                               pmgr_cmd=stub_cmd, spawn_proxies=False)
+    try:
+        configd.start()
+        launcherd.start()
+        key = (binding.chip_ids[0], "ns/mnist")
+        assert wait_for(lambda: key in launcherd._managers)
+        assert launcherd._managers[key][0] == binding.port
+
+        # workload finishes: scheduler reclaims + withdraws → manager dies
+        withdraw(registry, "ns/mnist")
+        eng.delete_pod("ns/mnist")
+        assert wait_for(lambda: key not in launcherd._managers)
+    finally:
+        launcherd.stop()
+        configd.stop()

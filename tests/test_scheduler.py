@@ -446,6 +446,33 @@ def test_set_fleet_batch_build():
     assert len(eng.nodes) == 3
 
 
+def test_set_fleet_removes_departed_nodes():
+    eng = SchedulerEngine()
+    topo = FakeTopology(hosts=2, mesh=(1,))
+    fleet: dict = {}
+    for chip in topo.chips():
+        fleet.setdefault(chip.host, ([], True))[0].append(chip)
+    eng.set_fleet(fleet)
+    assert len(eng.nodes) == 2
+    del fleet["tpu-host-1"]
+    eng.set_fleet(fleet)
+    assert eng.nodes == ["tpu-host-0"]
+    assert all(leaf.node == "tpu-host-0" for leaf in eng.leaf_cells.values())
+
+
+def test_port_exhaustion_resets_defaulted_memory():
+    eng = engine_with(hosts=1, mesh=(1,))
+    bitmap = eng.ports["tpu-host-0"]
+    for i in range(1, C.POD_MANAGER_PORT_RANGE):
+        bitmap.mask(i)  # exhaust the pool
+    pod = eng.submit("ns", "p", shared_labels("0.5", "1.0"))
+    with pytest.raises(Unschedulable, match="port pool"):
+        eng.reserve(pod, "tpu-host-0")
+    assert pod.memory == 0 and pod.cells == [] and pod.node_name == ""
+    leaf = next(iter(eng.leaf_cells.values()))
+    assert leaf.available == 1.0  # nothing booked
+
+
 def test_port_pool_round_robin_reuse():
     eng = engine_with(hosts=1, mesh=(1,))
     b1 = eng.schedule(eng.submit("ns", "a", shared_labels("0.3", "1.0")))
