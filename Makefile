@@ -1,0 +1,26 @@
+# Build/test entry points (reference Makefile parity: it builds 5 Go
+# binaries; here the native core + image + checks).
+
+PY ?= python
+
+.PHONY: all native test bench image clean
+
+all: native
+
+native: kubeshare_tpu/isolation/native/_build/libtokensched.so
+
+kubeshare_tpu/isolation/native/_build/libtokensched.so: kubeshare_tpu/isolation/native/tokensched.cpp
+	mkdir -p $(dir $@)
+	g++ -O2 -shared -fPIC -std=c++17 $< -o $@
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+image:
+	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .
+
+clean:
+	rm -f kubeshare_tpu/isolation/native/_build/libtokensched.so

@@ -1,0 +1,205 @@
+"""The scheduler as a deployable service.
+
+The reference compiles its engine into a full kube-scheduler binary
+(``cmd/kubeshare-scheduler/main.go:26-37``); the TPU-native engine is
+k8s-independent, so the deployable unit is this HTTP service: it syncs
+capacity from the telemetry registry before every decision (fresh reads —
+no PromQL window), schedules one pod per request, publishes the binding
+back to the registry for the node agents, and resyncs bound pods on
+restart (the crash recovery of ``pod.go:528-582``).
+
+API (JSON):
+
+- ``POST /schedule``  {"namespace","name","labels"{,"uid"}} → binding
+  (annotations + env) or 409 with the unschedulable reason
+- ``POST /resync``    {"namespace","name","labels","annotations","node"}
+- ``DELETE /pods/<ns>/<name>``
+- ``GET  /state``     engine snapshot (nodes, leaves, pods)
+- ``GET  /healthz``
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..telemetry.aggregator import publish_binding, sync_engine_from_registry, withdraw
+from ..telemetry.registry import RegistryClient, TelemetryRegistry
+from ..utils.logger import get_logger
+from .engine import SchedulerEngine, Unschedulable
+from .labels import LabelError
+
+log = get_logger("schedsvc")
+
+
+class SchedulerService:
+    def __init__(self, engine: SchedulerEngine,
+                 registry: RegistryClient | TelemetryRegistry):
+        self.engine = engine
+        self.registry = registry
+        self._lock = threading.Lock()  # one scheduling cycle at a time
+        self._server: ThreadingHTTPServer | None = None
+
+    # -- operations --------------------------------------------------------
+
+    def schedule(self, namespace: str, name: str, labels: dict,
+                 uid: str = "") -> dict:
+        with self._lock:
+            sync_engine_from_registry(self.engine, self.registry)
+            pod = self.engine.submit(namespace, name, labels, uid=uid)
+            binding = self.engine.schedule(pod)
+            if pod.needs_tpu:
+                publish_binding(self.registry, pod, binding)
+            decision, timeout_s = self.engine.permit(pod)
+            return {
+                "node": binding.node,
+                "annotations": binding.annotations,
+                "env": binding.env,
+                "permit": decision,
+                "permit_timeout_s": timeout_s,
+            }
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self.engine.delete_pod(key)
+            try:
+                withdraw(self.registry, key)
+            except Exception as e:
+                log.warning("withdraw %s failed: %s", key, e)
+
+    def resync(self, namespace: str, name: str, labels: dict,
+               annotations: dict, node: str) -> None:
+        with self._lock:
+            sync_engine_from_registry(self.engine, self.registry)
+            self.engine.resync_bound(namespace, name, labels, annotations,
+                                     node)
+
+    def state(self) -> dict:
+        eng = self.engine
+        return {
+            "nodes": eng.nodes,
+            "leaves": {cid: {"available": leaf.available,
+                             "free_memory": leaf.free_memory,
+                             "healthy": leaf.healthy}
+                       for cid, leaf in eng.leaf_cells.items()},
+            "pods": {key: {"node": p.node_name, "request": p.request,
+                           "limit": p.limit, "memory": p.memory,
+                           "chips": p.chip_ids, "port": p.port}
+                     for key, p in eng.pod_status.items()},
+        }
+
+    # -- HTTP --------------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1",
+              port: int = 0) -> ThreadingHTTPServer:
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, {"ok": True})
+                if self.path == "/state":
+                    return self._reply(200, svc.state())
+                self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    body = self._body()
+                    if self.path == "/schedule":
+                        result = svc.schedule(body["namespace"], body["name"],
+                                              body.get("labels", {}),
+                                              body.get("uid", ""))
+                        return self._reply(200, result)
+                    if self.path == "/resync":
+                        svc.resync(body["namespace"], body["name"],
+                                   body.get("labels", {}),
+                                   body.get("annotations", {}),
+                                   body.get("node", ""))
+                        return self._reply(200, {"ok": True})
+                except (LabelError, Unschedulable) as e:
+                    return self._reply(409, {"error": str(e)})
+                except Exception as e:
+                    log.error("request failed: %s", e)
+                    return self._reply(500, {"error": str(e)})
+                self._reply(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[0] == "pods":
+                    svc.delete(f"{parts[1]}/{parts[2]}")
+                    return self._reply(200, {"ok": True})
+                self._reply(404, {"error": "not found"})
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True,
+                         name="scheduler-service").start()
+        self._server = server
+        log.info("scheduler service on %s:%d", *server.server_address[:2])
+        return server
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def main(argv=None) -> None:
+    import argparse
+    import signal
+
+    from ..topology.cellconfig import load_config
+    from .configwatch import ConfigWatcher
+
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu.scheduler.service")
+    parser.add_argument("--registry-host", default="127.0.0.1")
+    parser.add_argument("--registry-port", type=int, required=True)
+    parser.add_argument("--port", type=int, default=9006)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--config", default="",
+                        help="optional topology YAML (auto-derived from "
+                             "discovery when omitted); the file is watched "
+                             "and the process exits on change for a clean "
+                             "rebuild (config.go:122-136 parity)")
+    args = parser.parse_args(argv)
+
+    config = load_config(args.config) if args.config else None
+    engine = SchedulerEngine(config=config)
+    registry = RegistryClient(args.registry_host, args.registry_port)
+    svc = SchedulerService(engine, registry)
+    svc.serve(args.host, args.port)
+    watcher = ConfigWatcher(args.config).start() if args.config else None
+    print("READY", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    if watcher:
+        watcher.stop()
+    svc.close()
+
+
+if __name__ == "__main__":
+    main()

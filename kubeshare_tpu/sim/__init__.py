@@ -1,0 +1,6 @@
+"""Simulation tooling: trace-driven scheduler replay (test/simulator
+parity, virtualized)."""
+
+from .simulator import SimStats, Simulator, TraceJob, parse_trace
+
+__all__ = ["SimStats", "Simulator", "TraceJob", "parse_trace"]

@@ -1,0 +1,184 @@
+"""Trace-driven scheduler simulator.
+
+Re-design of the reference's load generator
+(``test/simulator/simulator.py:1-87``): it replays ``trace.txt`` rows
+(tab-separated ``start-offset  n_gpus  runtime``, ``trace.txt:1-10``) by
+sleeping and ``kubectl apply``-ing busybox pods. Here the replay drives
+the :class:`~..scheduler.engine.SchedulerEngine` directly in *virtual*
+time — thousands of jobs simulate in milliseconds, deterministically
+(seeded), with placement/wait/utilization statistics out the end. This is
+the scheduler stress test the reference could only run against a live
+cluster.
+
+Workload synthesis keeps the reference's rule (``simulator.py:60-71``):
+rows asking > 2 chips become a random fractional request with limit 1.0;
+others request whole chips (request = limit = n).
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+from dataclasses import dataclass, field
+
+from .. import constants as C
+from ..scheduler import SchedulerEngine, Unschedulable
+from ..utils.logger import get_logger
+
+log = get_logger("simulator")
+
+
+@dataclass(frozen=True)
+class TraceJob:
+    offset_s: float       # submit delay after the previous job (the
+                          # reference sleeps per row, so offsets chain)
+    chips: int
+    runtime_s: float
+
+
+def parse_trace(text: str) -> list[TraceJob]:
+    jobs = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 3:
+            raise ValueError(f"bad trace row: {line!r}")
+        jobs.append(TraceJob(float(parts[0]), int(parts[1]),
+                             float(parts[2])))
+    return jobs
+
+
+def synthesize_labels(job: TraceJob, rng: random.Random) -> dict:
+    """Reference synthesis rule (simulator.py:60-71)."""
+    if job.chips > 2:
+        request = round(rng.random(), 2) or 0.01
+        return {C.POD_TPU_REQUEST: str(request), C.POD_TPU_LIMIT: "1.0"}
+    return {C.POD_TPU_REQUEST: str(job.chips),
+            C.POD_TPU_LIMIT: str(job.chips)}
+
+
+@dataclass
+class SimStats:
+    submitted: int = 0
+    placed: int = 0
+    failed: int = 0
+    retries: int = 0
+    total_wait_s: float = 0.0
+    chip_seconds: float = 0.0
+    makespan_s: float = 0.0
+    per_node: dict = field(default_factory=dict)
+
+    @property
+    def mean_wait_s(self) -> float:
+        return self.total_wait_s / self.placed if self.placed else 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "submitted": self.submitted, "placed": self.placed,
+            "failed": self.failed, "retries": self.retries,
+            "mean_wait_s": round(self.mean_wait_s, 3),
+            "chip_seconds": round(self.chip_seconds, 1),
+            "makespan_s": round(self.makespan_s, 1),
+            "per_node": self.per_node,
+        }
+
+
+class Simulator:
+    """Virtual-time replay of a trace against an engine.
+
+    Events: job submission (trace offsets, chained like the reference's
+    per-row sleeps) and job completion (placement time + runtime).
+    Unplaceable jobs go to a pending queue retried at every completion —
+    the kube-scheduler's requeue loop, virtualized. A job that still
+    cannot place when the trace drains counts as failed.
+    """
+
+    def __init__(self, engine: SchedulerEngine, seed: int = 0,
+                 namespace: str = "sim"):
+        self.engine = engine
+        self.rng = random.Random(seed)
+        self.namespace = namespace
+        self.stats = SimStats()
+
+    def run(self, jobs: list[TraceJob]) -> SimStats:
+        submit_time = 0.0
+        events: list[tuple[float, int, str, object]] = []
+        seq = 0
+        for job in jobs:
+            submit_time += job.offset_s
+            heapq.heappush(events, (submit_time, seq, "submit", job))
+            seq += 1
+        pending: list[tuple[str, TraceJob, float]] = []
+        now = 0.0
+
+        def try_place(name: str, job: TraceJob, submitted_at: float) -> bool:
+            nonlocal seq
+            pod = self.engine.pod_status.get(f"{self.namespace}/{name}")
+            if pod is None:
+                labels = synthesize_labels(job, self.rng)
+                pod = self.engine.submit(self.namespace, name, labels)
+            try:
+                binding = self.engine.schedule(pod)
+            except Unschedulable:
+                return False
+            self.stats.placed += 1
+            self.stats.total_wait_s += now - submitted_at
+            self.stats.chip_seconds += pod.request * job.runtime_s
+            self.stats.per_node[binding.node] = (
+                self.stats.per_node.get(binding.node, 0) + 1)
+            heapq.heappush(events, (now + job.runtime_s, seq, "complete",
+                                    pod.key))
+            return True
+
+        while events:
+            now, _, kind, payload = heapq.heappop(events)
+            if kind == "submit":
+                job = payload
+                name = f"job-{self.stats.submitted}"
+                self.stats.submitted += 1
+                if not try_place(name, job, now):
+                    pending.append((name, job, now))
+            else:
+                self.engine.delete_pod(payload)
+                still_pending = []
+                for name, job, submitted_at in pending:
+                    self.stats.retries += 1
+                    if not try_place(name, job, submitted_at):
+                        still_pending.append((name, job, submitted_at))
+                pending = still_pending
+        self.stats.failed = len(pending)
+        for name, _, _ in pending:
+            self.engine.delete_pod(f"{self.namespace}/{name}")
+        self.stats.makespan_s = now
+        return self.stats
+
+
+def main(argv=None) -> None:
+    import argparse
+    import json
+
+    from ..topology.discovery import parse_fake_spec
+
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu.sim.simulator")
+    parser.add_argument("--trace", required=True)
+    parser.add_argument("--topology", default="2:2x2@TPU-v4",
+                        help="fake fleet spec <hosts>:<mesh>[@model]")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    with open(args.trace) as f:
+        jobs = parse_trace(f.read())
+    engine = SchedulerEngine()
+    chips_by_host: dict = {}
+    for chip in parse_fake_spec(args.topology).chips():
+        chips_by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in chips_by_host.items():
+        engine.add_node(host, chips)
+    stats = Simulator(engine, seed=args.seed).run(jobs)
+    print(json.dumps(stats.to_json()))
+
+
+if __name__ == "__main__":
+    main()

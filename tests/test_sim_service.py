@@ -1,0 +1,196 @@
+"""Simulator, config watcher, scheduler service, standalone harness."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kubeshare_tpu import constants as C
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.configwatch import ConfigWatcher
+from kubeshare_tpu.scheduler.service import SchedulerService
+from kubeshare_tpu.sim import Simulator, TraceJob, parse_trace
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make_engine(hosts=2, mesh=(2, 2)):
+    eng = SchedulerEngine()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=hosts, mesh=mesh).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    return eng
+
+
+# --------------------------------------------------------------------------
+# simulator
+# --------------------------------------------------------------------------
+
+def test_parse_trace_rows():
+    jobs = parse_trace("# comment\n0\t1\t30\n12\t8\t900\n")
+    assert jobs == [TraceJob(0, 1, 30), TraceJob(12, 8, 900)]
+    with pytest.raises(ValueError):
+        parse_trace("1\t2\n")
+
+
+def test_simulator_places_and_completes():
+    eng = make_engine()
+    jobs = [TraceJob(0, 1, 100), TraceJob(1, 1, 100), TraceJob(1, 4, 50)]
+    stats = Simulator(eng, seed=1).run(jobs)
+    assert stats.submitted == 3
+    assert stats.placed == 3
+    assert stats.failed == 0
+    # all jobs completed → everything reclaimed
+    assert not eng.pod_status
+    assert all(l.available == l.leaf_cell_number
+               for l in eng.leaf_cells.values())
+
+
+def test_simulator_queues_until_capacity_frees():
+    eng = make_engine(hosts=1, mesh=(1,))
+    # three whole-chip jobs on one chip: they must serialize
+    jobs = [TraceJob(0, 1, 100), TraceJob(1, 1, 100), TraceJob(1, 1, 100)]
+    stats = Simulator(eng, seed=1).run(jobs)
+    assert stats.placed == 3 and stats.failed == 0
+    assert stats.retries >= 2          # later jobs waited for completions
+    assert stats.total_wait_s > 0
+    assert stats.makespan_s >= 300     # serialized runtimes
+
+
+def test_simulator_cli(tmp_path):
+    trace = tmp_path / "trace.txt"
+    trace.write_text("0\t1\t10\n5\t4\t20\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "kubeshare_tpu.sim.simulator",
+         "--trace", str(trace), "--topology", "1:2x2@TPU-v4"],
+        capture_output=True, text=True, cwd=REPO, check=True)
+    stats = json.loads(out.stdout.strip().splitlines()[-1])
+    assert stats["submitted"] == 2 and stats["placed"] == 2
+
+
+# --------------------------------------------------------------------------
+# config watcher
+# --------------------------------------------------------------------------
+
+def test_config_watcher_fires_on_change(tmp_path):
+    path = tmp_path / "topo.yaml"
+    path.write_text("cellTypes: {}\n")
+    fired = []
+    watcher = ConfigWatcher(str(path), on_change=lambda: fired.append(1),
+                            poll_s=0.05)
+    assert not watcher.check_once()
+    time.sleep(0.02)
+    path.write_text("cellTypes: {}\ncells: []\n")
+    assert watcher.check_once()
+    assert fired == [1]
+
+
+# --------------------------------------------------------------------------
+# scheduler service over HTTP
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def service():
+    registry = TelemetryRegistry()
+    chips = FakeTopology(hosts=1, mesh=(2,)).chips()
+    registry.put_capacity("tpu-host-0", [c.to_labels() for c in chips])
+    svc = SchedulerService(SchedulerEngine(), registry)
+    svc.serve()
+    yield svc, registry
+    svc.close()
+
+
+def http(method, port, path, body=None):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_service_schedules_and_publishes(service):
+    svc, registry = service
+    status, result = http("POST", svc.port, "/schedule", {
+        "namespace": "ns", "name": "p",
+        "labels": {C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"}})
+    assert status == 200
+    assert result["node"] == "tpu-host-0"
+    assert result["permit"] == "allow"
+    assert C.ENV_VISIBLE_CHIPS in result["env"]
+    assert registry.pods()["ns/p"]["node"] == "tpu-host-0"
+
+    status, state = http("GET", svc.port, "/state")
+    assert state["pods"]["ns/p"]["request"] == 0.5
+
+    status, _ = http("DELETE", svc.port, "/pods/ns/p")
+    assert status == 200
+    assert registry.pods() == {}
+
+
+def test_service_rejects_bad_labels_and_unschedulable(service):
+    svc, _ = service
+    status, err = http("POST", svc.port, "/schedule", {
+        "namespace": "ns", "name": "bad",
+        "labels": {C.POD_TPU_REQUEST: "1.0", C.POD_TPU_LIMIT: "0.5"}})
+    assert status == 409 and "tpu_limit" in err["error"]
+    status, err = http("POST", svc.port, "/schedule", {
+        "namespace": "ns", "name": "big",
+        "labels": {C.POD_TPU_REQUEST: "5", C.POD_TPU_LIMIT: "5"}})
+    assert status == 409
+
+
+def test_service_resync(service):
+    svc, _ = service
+    _, result = http("POST", svc.port, "/schedule", {
+        "namespace": "ns", "name": "p",
+        "labels": {C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"}})
+    # new service instance, same registry: resync re-books
+    svc2 = SchedulerService(SchedulerEngine(), svc.registry)
+    svc2.serve()
+    try:
+        status, _ = http("POST", svc2.port, "/resync", {
+            "namespace": "ns", "name": "p",
+            "labels": {C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"},
+            "annotations": result["annotations"], "node": result["node"]})
+        assert status == 200
+        _, state = http("GET", svc2.port, "/state")
+        chip = result["annotations"][C.POD_TPU_CHIP_ID]
+        assert state["leaves"][chip]["available"] == 0.5
+    finally:
+        svc2.close()
+
+
+# --------------------------------------------------------------------------
+# standalone harness (launch-backend parity) — config plumbing smoke
+# --------------------------------------------------------------------------
+
+def test_launch_backend_config_plumbs(tmp_path):
+    cfg = {"chips": ["TPU-v4-host-0"],
+           "clients": [{"name": "ns/a", "chip": "TPU-v4-host-0",
+                        "request": 0.5, "limit": 1.0, "port": 50171}]}
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "tools" / "launch_backend.py"),
+         "--config", str(cfg_path), "--base-dir", str(tmp_path),
+         "--platform", "cpu"],
+        stdout=subprocess.PIPE, text=True, cwd=REPO)
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["manager_ports"] == {"ns/a": 50171}
+        assert "TPU-v4-host-0" in info["exec_ports"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Standalone isolation-backend harness — no Kubernetes, no registry.
+
+Parity with ``docker/kubeshare-gemini-scheduler/launch-backend.py:1-89``,
+the reference's de-facto integration test for its Gemini stack: it starts
+gem-schd + N gem-pmgr from a hand-written config. Here: write the
+per-chip client files directly and let the real
+:class:`~kubeshare_tpu.nodeagent.launcherd.LauncherDaemon` bring up the
+chip proxy and pod managers, exactly as on a node.
+
+Config (JSON)::
+
+    {"chips": ["TPU-v4-host-0"],
+     "clients": [{"name": "ns/a", "chip": "TPU-v4-host-0",
+                  "request": 0.5, "limit": 1.0, "memory": 0,
+                  "port": 50151}]}
+
+Run: ``python tools/launch_backend.py --config cfg.json [--platform cpu]``
+then point workloads at each client's port (ExecutionGate) or at the
+chip's execution port (ProxyClient).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeshare_tpu.nodeagent.files import ClientEntry, write_chip_clients  # noqa: E402
+from kubeshare_tpu.nodeagent.launcherd import (LauncherDaemon,  # noqa: E402
+                                               default_pmgr_cmd,
+                                               default_proxy_cmd)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="launch_backend")
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--base-dir", default="")
+    parser.add_argument("--platform", default="",
+                        help="force the proxies' JAX platform (e.g. cpu)")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        cfg = json.load(f)
+    base_dir = args.base_dir or tempfile.mkdtemp(prefix="kubeshare-backend-")
+    chips = list(cfg.get("chips", []))
+
+    by_chip: dict[str, list[ClientEntry]] = {chip: [] for chip in chips}
+    for client in cfg.get("clients", []):
+        entry = ClientEntry(client["name"], float(client.get("request", 0)),
+                            float(client.get("limit", 1.0)),
+                            int(client.get("memory", 0)),
+                            int(client.get("port", 0)))
+        by_chip.setdefault(client.get("chip", chips[0] if chips else ""),
+                           []).append(entry)
+    for chip, entries in by_chip.items():
+        write_chip_clients(chip, entries, base_dir)
+
+    def proxy_cmd(chip_id, index, exec_port, token_port):
+        cmd, env = default_proxy_cmd(chip_id, index, exec_port, token_port)
+        if args.platform:
+            cmd += ["--platform", args.platform]
+        return cmd, env
+
+    daemon = LauncherDaemon(list(by_chip), base_dir=base_dir,
+                            proxy_cmd=proxy_cmd, pmgr_cmd=default_pmgr_cmd)
+    daemon.start()
+    print(json.dumps({
+        "base_dir": base_dir,
+        "exec_ports": daemon.exec_ports,
+        "token_ports": {c: daemon.token_port(c) for c in by_chip},
+        "manager_ports": {e.name: e.port for entries in by_chip.values()
+                          for e in entries if e.port},
+    }), flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
