@@ -2,17 +2,12 @@
 
 Multi-chip hardware is not available in CI; sharding logic is validated on a
 virtual CPU mesh (the fake-backend story the reference lacked — SURVEY §4).
+The actual forcing lives in ``kubeshare_tpu.utils.virtualcpu`` (shared with
+the driver entry ``__graft_entry__.dryrun_multichip``); that module imports
+no jax at module scope, so it is safe to call pre-initialization here.
 """
 
-import os
+from kubeshare_tpu.utils.virtualcpu import force_virtual_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # the host env presets axon (real TPU)
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The image's jax config pins jax_platforms=axon,cpu regardless of the env
-# var, so override it through the config API (before any backend init).
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+if not force_virtual_cpu(8):  # not an assert: -O must not skip the forcing
+    raise RuntimeError("jax initialized before conftest could force CPU")
