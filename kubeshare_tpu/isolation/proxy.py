@@ -39,7 +39,6 @@ from .tokensched import TokenScheduler
 log = get_logger("proxy")
 
 IDLE_RELEASE_MS = 10.0
-FIRST_BURST_STEPS = 128   # burst cap before a per-step time estimate exists
 
 
 def _now_ms() -> float:
@@ -56,7 +55,13 @@ class _Executable:
     ncarry: int | None = None     # loop programs: first ncarry args/outs thread
     fn: object = None             # AOT-compiled single call (lazy)
     chunk: object = None          # AOT-compiled dynamic-n loop (lazy)
-    step_ms: float = 0.0          # EMA of per-iteration device time
+    # Burst cost model: burst_ms ≈ step_ms + (n-1) * loop_step_ms. The two
+    # are tracked separately because XLA may run a while-loop body at a
+    # different speed than straight-line code (dramatically so on CPU,
+    # where loop bodies lose intra-op threading) — one blended EMA makes
+    # the burst cap oscillate between too long and too short.
+    step_ms: float = 0.0          # EMA of first-iteration / single-call time
+    loop_step_ms: float = 0.0     # EMA of per-iteration time INSIDE the loop
 
 
 @dataclass
@@ -86,6 +91,16 @@ class _Session:
 
 class HBMError(RuntimeError):
     pass
+
+
+class _ExecutionError(Exception):
+    """Wraps an exception raised by the device execution itself — as
+    opposed to token-gate failures (scheduler closed / client removed),
+    which happen before any buffer could have been donated."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
 
 
 class ChipProxy:
@@ -414,18 +429,39 @@ class ChipProxy:
         unpreemptible XLA execution, so an unbounded ``repeat`` would let a
         client monopolize the chip past its quota AND slip usage out of the
         sliding window. Cap the estimated burst near the scheduler's base
-        quantum (Gemini's burst ≙ quota relationship); before any timing
-        exists, allow a modest first burst to seed the estimate.
+        quantum (Gemini's burst ≙ quota relationship). Before any timing
+        exists the burst must be bounded by *wall time*, and the only way to
+        bound an unknown step is to run exactly one: a steps-count cap
+        (e.g. 128) at 200 ms/step would be a 25 s unpreemptible burst, 80×
+        the base quota, blowing the client's whole limit window. The second
+        dispatch is a 2-step probe that seeds the in-loop estimate; from
+        then on ``n`` solves step + (n-1)·loop_step ≤ 2·base.
         """
         core = getattr(self.scheduler, "core", None)
         base = getattr(core, "base_quota_ms", 300.0)
+        budget = 2.0 * base
         if exe.step_ms <= 0.0:
-            return min(repeat, FIRST_BURST_STEPS)
-        return max(1, min(repeat, int(2.0 * base / exe.step_ms) or 1))
+            return 1
+        if exe.loop_step_ms <= 0.0:
+            return min(repeat, 2)
+        n = 1 + int(max(0.0, budget - exe.step_ms) / exe.loop_step_ms)
+        return max(1, min(repeat, n))
 
     def _execute(self, sess: _Session, req: dict) -> dict:
         exe = sess.executables[int(req["exec_id"])]
         args = [sess.buffers[int(h)] for h in req["args"]]
+        # Validate args BEFORE dispatch: a shape/dtype mismatch must be a
+        # clean client error, not a device failure that (for loop
+        # programs) would be treated as having consumed the donated carry.
+        if len(args) != len(exe.in_specs):
+            raise ValueError(f"expected {len(exe.in_specs)} args, "
+                             f"got {len(args)}")
+        for i, (buf, spec) in enumerate(zip(args, exe.in_specs)):
+            if (tuple(buf.shape) != tuple(spec.shape)
+                    or str(buf.dtype) != str(spec.dtype)):
+                raise ValueError(
+                    f"arg {i}: got {tuple(buf.shape)}/{buf.dtype}, program "
+                    f"expects {tuple(spec.shape)}/{spec.dtype}")
         donate = [int(h) for h in req.get("donate", [])]
         repeat = int(req.get("repeat", 1))
         if repeat < 1:
@@ -445,15 +481,54 @@ class ChipProxy:
         # Cap check up front — allocation must not happen over-cap even
         # transiently (donated buffers are freed only after success).
         self._charge(sess, exe.out_nbytes)
-        start = _now_ms()
+        exec_ms_before = sess.exec_ms_total
+
+        def run_tagged():
+            try:
+                return self._run_fn(fn, args)
+            except Exception as e:
+                raise _ExecutionError(e) from e
+
         try:
-            outs = self._gated(sess, lambda: self._run_fn(fn, args))
+            outs = self._gated(sess, run_tagged)
+        except _ExecutionError as tagged:
+            err = tagged.cause
+            sess.hbm_used -= exe.out_nbytes
+            if exe.ncarry is not None:
+                # The chunk executable donates the carry at the XLA level,
+                # so a failed loop execution may already have invalidated
+                # those buffers. Drop the handles (and their HBM charge) and
+                # say so — dangling handles would surface as confusing
+                # errors on the next dispatch instead.
+                consumed = [int(h) for h in req["args"][:exe.ncarry]]
+                for handle in consumed:
+                    buf = sess.buffers.pop(handle, None)
+                    if buf is not None:
+                        sess.hbm_used -= int(buf.nbytes)
+                raise RuntimeError(
+                    f"loop execution failed and its donated carry was "
+                    f"consumed (handles {consumed} freed); re-put the "
+                    f"carry before retrying: {err}") from err
+            raise err
         except Exception:
+            # Token-gate failure (scheduler closed / client removed while
+            # waiting): nothing was dispatched, every buffer is intact.
             sess.hbm_used -= exe.out_nbytes
             raise
-        per_step = (_now_ms() - start) / repeat
-        exe.step_ms = (per_step if exe.step_ms <= 0.0
-                       else 0.5 * exe.step_ms + 0.5 * per_step)
+        # Update the burst cost model from the *gated* execution time only
+        # (sess.exec_ms_total delta; the session is connection-serialized).
+        # Timing around _gated() would fold the token wait into the
+        # estimate, and under contention _cap_repeat would then clamp
+        # bursts far below the intended 2x base-quantum of device time.
+        burst_ms = sess.exec_ms_total - exec_ms_before
+        if repeat == 1:
+            exe.step_ms = (burst_ms if exe.step_ms <= 0.0
+                           else 0.5 * exe.step_ms + 0.5 * burst_ms)
+        else:
+            first = exe.step_ms if exe.step_ms > 0.0 else burst_ms / repeat
+            per_loop = max(0.001, (burst_ms - first) / (repeat - 1))
+            exe.loop_step_ms = (per_loop if exe.loop_step_ms <= 0.0
+                                else 0.5 * exe.loop_step_ms + 0.5 * per_loop)
         handles = []
         for out in outs:
             handle = sess.fresh_id()

@@ -109,14 +109,14 @@ class ConfigDaemon:
 def main(argv=None) -> None:
     import argparse
     import signal
-    import socket
+    from ..utils import default_node_name
 
     from ..topology.discovery import discover_chips
 
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.nodeagent.configd")
     parser.add_argument("--registry-host", default="127.0.0.1")
     parser.add_argument("--registry-port", type=int, required=True)
-    parser.add_argument("--node", default=socket.gethostname())
+    parser.add_argument("--node", default=default_node_name())
     parser.add_argument("--base-dir", default=C.SCHEDULER_DIR)
     parser.add_argument("--backend", default="auto")
     parser.add_argument("--period", type=float, default=DEFAULT_PERIOD_S)

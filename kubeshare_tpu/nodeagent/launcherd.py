@@ -195,12 +195,12 @@ class LauncherDaemon:
 
 def main(argv=None) -> None:
     import argparse
-    import socket
+    from ..utils import default_node_name
 
     from ..topology.discovery import discover_chips
 
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.nodeagent.launcherd")
-    parser.add_argument("--node", default=socket.gethostname())
+    parser.add_argument("--node", default=default_node_name())
     parser.add_argument("--base-dir", default=C.SCHEDULER_DIR)
     parser.add_argument("--backend", default="auto")
     parser.add_argument("--poll", type=float, default=DEFAULT_POLL_S)

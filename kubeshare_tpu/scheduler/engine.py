@@ -406,6 +406,10 @@ class SchedulerEngine:
                 and p.namespace == pod.namespace
                 for p in self.pod_status.values()):
             self.groups.mark_expired(pod.group_key)
+        # Opportunistic GC (the dispatcher also runs it on a 30s cadence,
+        # scheduler.go:233): without it a long-running engine accumulates
+        # expired group entries indefinitely.
+        self.groups.gc()
 
     def resync_bound(self, namespace: str, name: str, labels: dict,
                      annotations: dict, node_name: str,
@@ -468,5 +472,15 @@ class SchedulerEngine:
             raise Unschedulable(f"{pod.key}: no node passed filtering")
         raw = {node: self.score(pod, node) for node in candidates}
         norm = self.normalize_scores(raw)
-        best = max(candidates, key=lambda n: (norm[n], n))
-        return self.reserve(pod, best)
+        # Walk candidates best-first: a reserve-time refusal (select_cells
+        # sees different constraints than the filter DFS, e.g. raced
+        # capacity) falls back to the next-ranked node instead of aborting
+        # the whole cycle on a feasible pod.
+        last_err: Unschedulable | None = None
+        for node in sorted(candidates, key=lambda n: (norm[n], n),
+                           reverse=True):
+            try:
+                return self.reserve(pod, node)
+            except Unschedulable as err:
+                last_err = err
+        raise last_err if last_err is not None else Unschedulable(pod.key)

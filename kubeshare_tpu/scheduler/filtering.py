@@ -16,6 +16,8 @@ cells entirely — unhealthy capacity stays booked but is never offered
 
 from __future__ import annotations
 
+import math
+
 from ..topology.cell import LOWEST_LEVEL, Cell, FreeList
 
 
@@ -48,9 +50,15 @@ def check_cell_resource(cell: Cell, node_name: str, request: float,
                     return True, whole, free_mem
         return False, whole, free_mem
     for cur in _node_subtree(cell, node_name):
-        if (cur.level == LOWEST_LEVEL and cur.node == node_name
-                and cur.available >= request and cur.free_memory >= memory):
-            return True, cur.available, cur.free_memory
+        if cur.level == LOWEST_LEVEL and cur.node == node_name:
+            # Check the memory that will actually be booked: an unset
+            # tpu_mem defaults to request x full HBM at reserve time
+            # (pod.go:419-424, select_cells), so checking 0 here would
+            # pass a leaf that reserve then rejects — aborting the cycle
+            # even though another candidate node fits.
+            needed = memory or int(math.floor(request * cur.full_memory))
+            if cur.available >= request and cur.free_memory >= needed:
+                return True, cur.available, cur.free_memory
     return False, 0.0, 0
 
 

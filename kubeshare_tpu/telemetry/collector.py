@@ -37,10 +37,10 @@ class CapacityCollector:
 
     def __init__(self, registry: RegistryClient, node: str | None = None,
                  backend: str = "auto", period_s: float = DEFAULT_PERIOD_S):
-        import socket
+        from ..utils import default_node_name
 
         self.registry = registry
-        self.node = node or socket.gethostname()
+        self.node = node or default_node_name()
         self.backend = backend
         self.period_s = period_s
         self._stop = threading.Event()
@@ -128,12 +128,12 @@ def serve_metrics(get_chips, node: str, host: str = "0.0.0.0",
 def main(argv=None) -> None:
     import argparse
     import signal
-    import socket
+    from ..utils import default_node_name
 
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.telemetry.collector")
     parser.add_argument("--registry-host", default="127.0.0.1")
     parser.add_argument("--registry-port", type=int, required=True)
-    parser.add_argument("--node", default=socket.gethostname())
+    parser.add_argument("--node", default=default_node_name())
     parser.add_argument("--backend", default="auto")
     parser.add_argument("--period", type=float, default=DEFAULT_PERIOD_S)
     parser.add_argument("--metrics-port", type=int, default=0,

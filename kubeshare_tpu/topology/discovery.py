@@ -17,9 +17,9 @@ Two backends:
 from __future__ import annotations
 
 import os
-import socket
 from dataclasses import dataclass
 
+from ..utils import default_node_name
 from .chip import ChipInfo, make_chip_id, normalize_model
 
 DEFAULT_FAKE_HBM = 16 * 1024**3
@@ -68,7 +68,7 @@ class FakeTopology:
 def _jax_chips(host: str | None = None) -> list[ChipInfo]:
     import jax
 
-    host = host or os.environ.get("NODE_NAME") or socket.gethostname()
+    host = host or default_node_name()
     chips: list[ChipInfo] = []
     for d in jax.local_devices():
         model = normalize_model(d.device_kind)

@@ -1,4 +1,17 @@
 from .bitmap import Bitmap, RRBitmap
 from .logger import get_logger
 
-__all__ = ["Bitmap", "RRBitmap", "get_logger"]
+
+def default_node_name() -> str:
+    """The node identity daemons key their data with. The deploy manifests
+    inject NODE_NAME via the downward API (≙ node-daemon.yaml:79-83);
+    it must win over the kernel hostname — on clusters where the two
+    differ, hostname-keyed capacity/bindings would name a node no kubelet
+    can bind pods to."""
+    import os
+    import socket
+
+    return os.environ.get("NODE_NAME") or socket.gethostname()
+
+
+__all__ = ["Bitmap", "RRBitmap", "default_node_name", "get_logger"]

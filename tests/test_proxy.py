@@ -259,8 +259,18 @@ def test_compile_loop_fuses_steps(proxy):
         w = c.put(np.zeros(4, np.float32))
         batch = (c.put(xs), c.put(ys))
         loop = c.compile_loop(step, w, batch)
+        # Burst sizing warms up wall-time-bounded: the first dispatch is
+        # clamped to ONE step (no time estimate yet), the second is a
+        # 2-step probe that seeds the in-loop estimate.
+        w, l = loop(60, w, batch)
+        assert loop.last_n == 1
+        c.free(l)
+        w, l = loop(60, w, batch)
+        assert loop.last_n == 2
+        c.free(l)
         used_before = c.usage()["exec_count"]
         w, l = loop(60, w, batch)
+        assert loop.last_n == 60  # estimates seeded → full fused burst
         assert c.usage()["exec_count"] == used_before + 1  # ONE dispatch
         assert float(c.get(l)) < 1e-3
         np.testing.assert_allclose(c.get(w), w_true, atol=1e-2)
@@ -276,6 +286,22 @@ def test_compile_loop_repeat_one(proxy):
         w2, aux = loop(1, w)
         assert float(c.get(w2)) == 4.0
         assert float(c.get(aux)) == 2.0
+
+
+def test_loop_arg_error_preserves_carry(proxy):
+    """A shape mismatch must be rejected BEFORE dispatch: the donated
+    carry is only consumed by a real device execution, so after a pure
+    argument error the carry handles must still work."""
+    with connect(proxy, "argerr") as c:
+        w = c.put(np.float32(3.0))
+        x = c.put(np.ones(2, np.float32))
+        loop = c.compile_loop(lambda w, x: (w + 1.0, w), w, x)
+        bad = c.put(np.ones(5, np.float32))  # wrong shape for x's slot
+        with pytest.raises(RuntimeError, match="expects"):
+            loop(1, w, bad)
+        w2, aux = loop(1, w, x)  # carry survived the argument error
+        assert float(c.get(w2)) == 4.0
+        assert float(c.get(aux)) == 3.0
 
 
 def test_plain_execute_rejects_repeat(proxy):

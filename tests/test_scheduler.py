@@ -368,6 +368,29 @@ def test_defaulted_memory_cannot_overcommit():
     assert leaf.free_memory >= 0
 
 
+def test_filter_checks_defaulted_memory_like_reserve():
+    """Filter must apply the same request x full-HBM default as reserve:
+    a node whose leaves have compute headroom but tight free HBM must be
+    rejected at filter time, and schedule() must fall back to a node that
+    actually fits instead of aborting the cycle (round-2 advisor medium)."""
+    eng = SchedulerEngine()
+    tight = FakeTopology(hosts=1, mesh=(1,), host_prefix="tight").chips()
+    roomy = FakeTopology(hosts=1, mesh=(1,), host_prefix="roomy").chips()
+    eng.add_node(tight[0].host, tight)
+    eng.add_node(roomy[0].host, roomy)
+    # eat 3/4 of the tight node's HBM with a tiny compute fraction
+    eng.schedule(eng.submit("ns", "hog", {
+        C.POD_TPU_REQUEST: "0.1", C.POD_TPU_LIMIT: "1.0",
+        C.POD_TPU_MEMORY: str(3 * HBM // 4)}), nodes=[tight[0].host])
+    # 0.5 request with unset tpu_mem -> needs HBM/2; tight has HBM/4 free
+    fit, why = eng.filter(
+        eng.submit("ns", "p", shared_labels("0.5", "1.0")), tight[0].host)
+    assert not fit, why
+    binding = eng.schedule(
+        eng.submit("ns", "p2", shared_labels("0.5", "1.0")))
+    assert binding.node == roomy[0].host
+
+
 def test_resubmit_new_uid_reclaims_old_incarnation():
     eng = engine_with(hosts=1, mesh=(1,))
     eng.schedule(eng.submit("ns", "p", shared_labels("0.5", "1.0"), uid="A"))
