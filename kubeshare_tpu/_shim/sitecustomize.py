@@ -1,0 +1,20 @@
+"""Zero-touch attach shim — the LD_PRELOAD equivalent.
+
+The node agent (≙ the hook-init initContainer installing libgemhook to a
+hostPath, ``docker/kubeshare-gemini-hook-init/Dockerfile:27-28``) puts
+this directory on the workload container's PYTHONPATH; Python imports
+``sitecustomize`` automatically at interpreter startup, before any
+workload code runs. With no kubeshare env present this is a no-op, so the
+shim is safe to install globally.
+"""
+
+try:
+    from kubeshare_tpu.attach import attach_if_env
+
+    attach_if_env()
+except Exception:  # never break the interpreter for a workload
+    import sys
+    import traceback
+
+    print("kubeshare-tpu attach shim failed:", file=sys.stderr)
+    traceback.print_exc()

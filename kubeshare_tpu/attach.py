@@ -1,0 +1,412 @@
+"""Transparent attach: route an UNMODIFIED JAX workload through the
+isolation runtime, driven purely by environment variables.
+
+The reference achieves zero-touch attach by injecting
+``LD_PRELOAD=libgemhook.so.1`` + ``POD_MANAGER_PORT`` into the pod spec
+(``pkg/scheduler/pod.go:445-457``); the hook intercepts the CUDA driver
+API and the workload never knows. The Python/JAX equivalent is a
+``sitecustomize`` shim (``kubeshare_tpu/_shim/sitecustomize.py``) that the
+node agent puts on the container's ``PYTHONPATH``; it calls
+:func:`attach_if_env` before the workload's first ``import jax``.
+
+Two modes, chosen from the injected env:
+
+- **proxy** (``KUBESHARE_TPU_CHIP_PROXY_PORT`` set): the workload must
+  NOT own the chip (single-tenant per process). The client process is
+  forced onto the CPU backend and ``jax.jit`` is replaced by a wrapper
+  that traces the function abstractly, compiles it on the
+  :class:`~.isolation.proxy.ChipProxy`, and executes it there. Arrays
+  returned from jitted calls are :class:`RemoteArray` handles — they stay
+  device-resident on the proxy and flow back into later jitted calls as
+  handles, so a training loop ships its parameters once. Reading one
+  (``float(loss)``, ``np.asarray``) fetches it.
+- **gate** (only ``KUBESHARE_TPU_POD_MANAGER_PORT`` set): Gemini-parity
+  metering without execution forwarding — every jitted call first passes
+  an :class:`~.isolation.client.ExecutionGate` token round-trip (the
+  hook ⇄ gem-pmgr ⇄ gem-schd loop). This is the fallback for a shared
+  pod whose node agent did not inject a chip-proxy port (the process
+  dispatches to the device itself, sharing only via tokens — exactly the
+  reference's model on multi-process-capable devices). Whole-chip pods
+  (port 0) attach nothing, matching the reference's multi-GPU path
+  (pod.go:348-400: no LD_PRELOAD, no port).
+
+Neither mode requires a single source change in the workload:
+``python -m kubeshare_tpu.models.mnist`` (or any JAX script) attaches
+through env vars alone.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from . import constants as C
+from .utils.logger import get_logger
+
+log = get_logger("attach")
+
+_state_lock = threading.Lock()
+_active: "_AttachState | None" = None
+
+
+class _AttachState:
+    def __init__(self, mode: str, real_jit, shim=None, gate=None):
+        self.mode = mode
+        self.real_jit = real_jit
+        self.shim = shim
+        self.gate = gate
+
+
+class RemoteArray:
+    """A device-resident array on the chip proxy, posing as the result of
+    a jitted call. Cheap to thread back into further jitted calls (it
+    travels as a handle); materializing it (``np.asarray``, ``float``)
+    fetches the bytes."""
+
+    def __init__(self, shim: "_ProxyShim", buf):
+        self._shim = shim
+        self.buf = buf
+
+    @property
+    def shape(self):
+        return self.buf.shape
+
+    @property
+    def dtype(self):
+        return np.dtype(self.buf.dtype)
+
+    @property
+    def ndim(self):
+        return len(self.buf.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.buf.shape:
+            n *= d
+        return n
+
+    @property
+    def nbytes(self):
+        return self.buf.nbytes
+
+    def block_until_ready(self):
+        return self  # the proxy blocks on device completion per dispatch
+
+    def fetch(self) -> np.ndarray:
+        return self._shim.fetch(self.buf)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self.fetch()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self.fetch())
+
+    def __int__(self):
+        return int(self.fetch())
+
+    def __bool__(self):
+        return bool(self.fetch())
+
+    def __index__(self):
+        return int(self.fetch())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.fetch()[()], spec)
+        return format(repr(self), spec)
+
+    def __repr__(self):
+        return f"RemoteArray(shape={tuple(self.shape)}, dtype={self.dtype})"
+
+    def __del__(self):
+        # No I/O here: __del__ can fire on any thread mid-protocol-call.
+        # Queue the handle; the shim flushes before its next operation.
+        try:
+            self._shim.queue_free(self.buf)
+        except Exception:
+            pass
+
+
+class _ProxyShim:
+    """Owns the ProxyClient connection + the jax.jit replacement."""
+
+    def __init__(self, host: str, port: int, name: str, request: float,
+                 limit: float, memory: int):
+        from .isolation.client import ProxyClient
+
+        self.client = ProxyClient(host, port, name, request, limit,
+                                  memory=memory)
+        self._pending_free: list = []
+        self._lock = threading.Lock()
+
+    # -- deferred frees ----------------------------------------------------
+
+    def queue_free(self, buf) -> None:
+        with self._lock:
+            self._pending_free.append(buf)
+
+    def _flush_frees(self) -> None:
+        with self._lock:
+            bufs, self._pending_free = self._pending_free, []
+        if bufs:
+            try:
+                self.client.free(*bufs)
+            except Exception:
+                pass
+
+    def fetch(self, buf) -> np.ndarray:
+        self._flush_frees()
+        return self.client.get(buf)
+
+    # -- the jax.jit replacement ------------------------------------------
+
+    def jit(self, fn=None, **jit_kwargs):
+        if fn is None:  # decorator-with-arguments form
+            return lambda f: self.jit(f, **jit_kwargs)
+        return _RemoteJitFunction(self, fn, jit_kwargs)
+
+    def close(self) -> None:
+        try:
+            self.client.close()
+        except Exception:
+            pass
+
+
+class _RemoteJitFunction:
+    """Stand-in for a ``jax.jit``-wrapped function: traces remotely on
+    first call per (structure, shapes, statics) and executes on the proxy
+    thereafter."""
+
+    def __init__(self, shim: _ProxyShim, fn, jit_kwargs: dict):
+        self._shim = shim
+        self._fn = fn
+        self._static_argnums = _as_tuple(jit_kwargs.get("static_argnums"))
+        self._static_argnames = _as_tuple(jit_kwargs.get("static_argnames"))
+        # donate_argnums is accepted but not forwarded: the proxy frees
+        # dead buffers via RemoteArray GC instead (XLA-level donation is
+        # reserved for the fused-loop path where aliasing is structural).
+        self._cache: dict = {}
+        self.__wrapped__ = fn
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        if _contains_tracers(args, kwargs):
+            # We're INSIDE a trace (a library helper jitted at call time,
+            # e.g. optax.tree.bias_correction, invoked from a function
+            # being remoted): inline into the enclosing program, exactly
+            # what a nested jit does.
+            return self._fn(*args, **kwargs)
+
+        shim = self._shim
+        shim._flush_frees()
+
+        static_items = []
+        dyn_args = list(args)
+        for i in sorted(self._static_argnums, reverse=True):
+            if i < len(dyn_args):
+                static_items.append((f"#{i}", dyn_args.pop(i)))
+        dyn_kwargs = dict(kwargs)
+        for name in self._static_argnames:
+            if name in dyn_kwargs:
+                static_items.append((name, dyn_kwargs.pop(name)))
+        static_items.sort()
+
+        tree = (tuple(dyn_args), dyn_kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        bufs = [x.buf if isinstance(x, RemoteArray) else x for x in leaves]
+        specs = tuple(_leaf_spec(b) for b in bufs)
+        key = (treedef, specs, tuple(static_items))
+
+        exe = self._cache.get(key)
+        if exe is None:
+            exe = self._compile(treedef, specs, static_items)
+            self._cache[key] = exe
+        out = exe(jax.tree_util.tree_unflatten(treedef, bufs))
+        from .isolation.client import RemoteBuffer
+
+        return jax.tree_util.tree_map(
+            lambda b: RemoteArray(shim, b) if isinstance(b, RemoteBuffer)
+            else b, out)
+
+    def _compile(self, treedef, specs, static_items):
+        import jax
+
+        fn = self._fn
+        statics = dict(static_items)
+
+        def wrapped(tree):
+            args, kwargs = tree
+            args = list(args)
+            # re-insert static positionals in ascending index order — the
+            # lexicographic dict order would place '#10' before '#2' and
+            # bind values to the wrong parameters
+            for k in sorted((k for k in statics if k.startswith("#")),
+                            key=lambda k: int(k[1:])):
+                args.insert(int(k[1:]), statics[k])
+            for k, v in statics.items():
+                if not k.startswith("#"):
+                    kwargs = dict(kwargs, **{k: v})
+            return fn(*args, **kwargs)
+
+        example_leaves = [jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+                          for shape, dtype in specs]
+        example = jax.tree_util.tree_unflatten(treedef, example_leaves)
+        return self._shim.client.compile(wrapped, example)
+
+
+def _contains_tracers(args, kwargs) -> bool:
+    """True when a call is happening under an enclosing jax trace."""
+    import jax
+
+    return any(isinstance(x, jax.core.Tracer)
+               for x in jax.tree_util.tree_leaves((args, kwargs)))
+
+
+def _as_tuple(v):
+    if v is None:
+        return ()
+    if isinstance(v, (int, str)):
+        return (v,)
+    return tuple(v)
+
+
+def _leaf_spec(leaf):
+    from .isolation.client import RemoteBuffer
+
+    if isinstance(leaf, RemoteBuffer):
+        return (tuple(leaf.shape), str(leaf.dtype))
+    arr = np.asarray(leaf)
+    return (tuple(arr.shape), str(arr.dtype))
+
+
+# --------------------------------------------------------------------------
+# activation
+# --------------------------------------------------------------------------
+
+def attach_proxy(host: str, port: int, name: str, request: float,
+                 limit: float, memory: int = 0) -> None:
+    """Force the CPU backend and replace ``jax.jit`` with the remote
+    shim. Must run before the workload's first backend use."""
+    global _active
+    with _state_lock:
+        if _active is not None:
+            raise RuntimeError(f"already attached ({_active.mode})")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+        shim = _ProxyShim(host, port, name, request, limit, memory)
+        real_jit = jax.jit
+        jax.jit = shim.jit
+        _active = _AttachState("proxy", real_jit, shim=shim)
+        log.info("attached (proxy mode) to %s:%d as %s "
+                 "(request=%.2f limit=%.2f)", host, port, name, request, limit)
+
+
+def attach_gate(host: str, port: int, name: str, request: float,
+                limit: float) -> None:
+    """Token-gate every jitted call; the workload keeps chip ownership
+    (whole-chip pods)."""
+    global _active
+    with _state_lock:
+        if _active is not None:
+            raise RuntimeError(f"already attached ({_active.mode})")
+        from .isolation.client import ExecutionGate
+
+        gate = ExecutionGate.connect(host, port, name, request, limit)
+        import jax
+
+        real_jit = jax.jit
+
+        def gated_jit(fn=None, **kw):
+            if fn is None:
+                return lambda f: gated_jit(f, **kw)
+            jitted = real_jit(fn, **kw)
+
+            def run(*args, **kwargs):
+                if not _contains_tracers(args, kwargs):
+                    gate()  # only meter real dispatches, not nested traces
+                return jitted(*args, **kwargs)
+
+            run.__wrapped__ = jitted
+            return run
+
+        jax.jit = gated_jit
+        _active = _AttachState("gate", real_jit, gate=gate)
+        log.info("attached (gate mode) to %s:%d as %s", host, port, name)
+
+
+def attach_if_env() -> str:
+    """Entry point for the sitecustomize shim: attach according to the
+    injected env (no-op without it). Returns the mode activated
+    ("proxy" | "gate" | "")."""
+    mode = os.environ.get(C.ENV_ATTACH_MODE, "").lower()
+    if mode == "off" or _active is not None:
+        return ""
+    proxy_port = int(os.environ.get(C.ENV_CHIP_PROXY_PORT, "0") or 0)
+    mgr_port = int(os.environ.get(C.ENV_POD_MANAGER_PORT, "0") or 0)
+    if mode == "proxy" and not proxy_port:
+        log.warning("attach mode 'proxy' requested but %s unset",
+                    C.ENV_CHIP_PROXY_PORT)
+        return ""
+    if mode == "gate" and not mgr_port:
+        log.warning("attach mode 'gate' requested but %s unset",
+                    C.ENV_POD_MANAGER_PORT)
+        return ""
+    # Both endpoints are NODE-LOCAL (launcherd spawns the chip proxy and
+    # the pod manager on the workload's own node, hostNetwork) — never
+    # dial the cluster scheduler's IP here.
+    host = os.environ.get("KUBESHARE_TPU_ATTACH_HOST", "") or "127.0.0.1"
+    name = os.environ.get(C.ENV_POD_NAME, "") or f"pid-{os.getpid()}"
+    request = float(os.environ.get(C.ENV_TPU_REQUEST, "0") or 0)
+    limit = float(os.environ.get(C.ENV_TPU_LIMIT, "0") or 0) or max(
+        request, 1.0)
+    request = request or limit
+    memory = int(os.environ.get(C.ENV_TPU_MEMORY, "0") or 0)
+    if proxy_port and mode in ("", "proxy"):
+        attach_proxy(host, proxy_port, name, request, limit, memory)
+        return "proxy"
+    if mgr_port and mode in ("", "gate"):
+        attach_gate(host, mgr_port, name, request, limit)
+        return "gate"
+    return ""
+
+
+def detach() -> None:
+    """Undo the attach (tests / graceful shutdown)."""
+    global _active
+    with _state_lock:
+        if _active is None:
+            return
+        import jax
+
+        jax.jit = _active.real_jit
+        if _active.shim is not None:
+            _active.shim.close()
+        if _active.gate is not None:
+            _active.gate.close()
+        _active = None
+
+
+def active_mode() -> str:
+    return _active.mode if _active is not None else ""
+
+
+def real_jit():
+    """The genuine ``jax.jit`` even while the attach shim has replaced the
+    public attribute — framework internals (client tracing, the proxy's
+    AOT compiles) must never recurse into the shim."""
+    state = _active
+    if state is not None and state.real_jit is not None:
+        return state.real_jit
+    import jax
+
+    return jax.jit

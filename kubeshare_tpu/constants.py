@@ -44,6 +44,17 @@ ENV_POD_MANAGER_PORT = "KUBESHARE_TPU_POD_MANAGER_PORT"
 ENV_POD_NAME = "KUBESHARE_TPU_POD_NAME"
 ENV_SCHEDULER_IP = "KUBESHARE_TPU_SCHEDULER_IP"
 
+# Transparent-attach contract (≙ the LD_PRELOAD zero-touch contract,
+# pod.go:445-457): a sitecustomize shim on PYTHONPATH reads these and
+# routes an UNMODIFIED JAX workload through the isolation runtime — see
+# kubeshare_tpu/attach.py. The chip-proxy port is node-local state the
+# launcher daemon owns; the share parameters come from the binding.
+ENV_CHIP_PROXY_PORT = "KUBESHARE_TPU_CHIP_PROXY_PORT"
+ENV_TPU_REQUEST = "KUBESHARE_TPU_REQUEST"
+ENV_TPU_LIMIT = "KUBESHARE_TPU_LIMIT"
+ENV_TPU_MEMORY = "KUBESHARE_TPU_MEM"
+ENV_ATTACH_MODE = "KUBESHARE_TPU_ATTACH"  # proxy | gate | off (default auto)
+
 # Library/host paths (pod.go:23-26, cmd/kubeshare-query-ip/main.go:22-34).
 LIBRARY_PATH = "/var/lib/kubeshare-tpu/library"
 SCHEDULER_IP_FILE = LIBRARY_PATH + "/schedulerIP.txt"

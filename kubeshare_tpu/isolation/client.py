@@ -31,6 +31,15 @@ from .protocol import dump_array, load_array
 log = get_logger("client")
 
 
+def _real_jit():
+    """The genuine ``jax.jit`` even when the transparent-attach shim has
+    replaced the public attribute (attach.py routes workload jits through
+    THIS client — tracing here must not recurse into the shim)."""
+    from ..attach import real_jit
+
+    return real_jit()
+
+
 @dataclass(frozen=True)
 class RemoteBuffer:
     """A device-resident array on the proxy."""
@@ -210,7 +219,7 @@ class ProxyClient:
             return tuple(out_leaves)
 
         exported = export.export(
-            jax.jit(flat_fn), platforms=list(self.platforms))(*flat_specs)
+            _real_jit()(flat_fn), platforms=list(self.platforms))(*flat_specs)
         msg = {"op": "compile", "name": self.name}
         if ncarry is not None:
             msg["ncarry"] = ncarry

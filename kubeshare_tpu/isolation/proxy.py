@@ -121,6 +121,7 @@ class ChipProxy:
         self.idle_release_ms = idle_release_ms
         self._sessions: dict[str, _Session] = {}
         self._slock = threading.Lock()
+        self.total_execs = 0          # lifetime, survives session drops
         self._server: protocol.FramedServer | None = None
         self._stop = threading.Event()
         self._watchdog: threading.Thread | None = None
@@ -381,12 +382,14 @@ class ChipProxy:
         full parameter set).
         """
         if exe.fn is None:
+            from ..attach import real_jit
+
             call = exe.call
 
             def _single(*args):
                 return call(*args)
 
-            exe.fn = (self._jax.jit(_single)
+            exe.fn = (real_jit()(_single)
                       .lower(*exe.in_specs).compile())
         return exe.fn
 
@@ -399,6 +402,8 @@ class ChipProxy:
         stay device-resident throughout; one compile serves every N.
         """
         if exe.chunk is None:
+            from ..attach import real_jit
+
             jax = self._jax
             call, ncarry = exe.call, exe.ncarry
 
@@ -419,8 +424,8 @@ class ChipProxy:
             # The protocol always donates the carry (RemoteLoop frees those
             # handles on success), so give XLA the aliasing: without it a
             # training client needs 2x its state in HBM at every dispatch.
-            exe.chunk = (jax.jit(chunk,
-                                 donate_argnums=tuple(range(1, ncarry + 1)))
+            exe.chunk = (real_jit()(chunk,
+                                    donate_argnums=tuple(range(1, ncarry + 1)))
                          .lower(nspec, *exe.in_specs).compile())
         return exe.chunk
 
@@ -529,6 +534,8 @@ class ChipProxy:
             per_loop = max(0.001, (burst_ms - first) / (repeat - 1))
             exe.loop_step_ms = (per_loop if exe.loop_step_ms <= 0.0
                                 else 0.5 * exe.loop_step_ms + 0.5 * per_loop)
+        with self._slock:  # connection threads share this counter
+            self.total_execs += 1
         handles = []
         for out in outs:
             handle = sess.fresh_id()

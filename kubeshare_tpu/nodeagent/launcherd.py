@@ -33,6 +33,14 @@ DEFAULT_POLL_S = 0.5
 TOKEN_PORT_OFFSET = 1000
 
 
+def exec_port_map(chip_ids: list[str]) -> dict[str, int]:
+    """chip → chip-proxy execution port, deterministic by discovery order
+    (gem-schd's port 49901+i rule, ``launcher.py:27-29``). The same
+    mapping lets the env-injection path compute ENV_CHIP_PROXY_PORT for a
+    bound workload from its chip's local index."""
+    return {chip: C.SCHD_PORT_START + i for i, chip in enumerate(chip_ids)}
+
+
 def default_proxy_cmd(chip_id: str, index: int, exec_port: int,
                       token_port: int) -> tuple[list[str], dict]:
     """The real per-chip command (gem-schd launch parity,
@@ -74,8 +82,7 @@ class LauncherDaemon:
         self.proxy_cmd = proxy_cmd
         self.pmgr_cmd = pmgr_cmd
         self.spawn_proxies = spawn_proxies
-        self.exec_ports = {chip: C.SCHD_PORT_START + i
-                           for i, chip in enumerate(self.chip_ids)}
+        self.exec_ports = exec_port_map(self.chip_ids)
         self._proxies: dict[str, subprocess.Popen] = {}
         # (chip_id, client name) -> (port, process)
         self._managers: dict[tuple[str, str], tuple[int, subprocess.Popen]] = {}

@@ -65,6 +65,8 @@ class Binding:
     models: list[str]
     memory: int
     port: int = 0                 # 0 for whole-chip pods (no manager)
+    request: float = 0.0          # share params, re-injected as env for
+    limit: float = 0.0            # the zero-touch attach shim
 
     @property
     def annotations(self) -> dict[str, str]:
@@ -84,6 +86,12 @@ class Binding:
         if self.port:
             env[C.ENV_POD_MANAGER_PORT] = str(self.port)
             env[C.ENV_POD_NAME] = self.pod_key
+            # the zero-touch attach shim (kubeshare_tpu/attach.py) reads
+            # these to register with the pod's share parameters; the
+            # chip-proxy port is node-local and injected by the launcher
+            env[C.ENV_TPU_REQUEST] = str(self.request)
+            env[C.ENV_TPU_LIMIT] = str(self.limit)
+            env[C.ENV_TPU_MEMORY] = str(self.memory)
         return env
 
 
@@ -353,7 +361,8 @@ class SchedulerEngine:
         pod.bookings.append((cell.chip_id, pod.request, pod.memory))
         pod.port = C.POD_MANAGER_PORT_START + offset
         return Binding(pod.key, node_name, pod.chip_ids, [cell.id],
-                       [cell.cell_type], pod.memory, pod.port)
+                       [cell.cell_type], pod.memory, pod.port,
+                       request=pod.request, limit=pod.limit)
 
     def unreserve(self, pod: PodRequest) -> list[str]:
         """Roll back a reservation; returns group members that should be
