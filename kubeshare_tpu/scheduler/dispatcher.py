@@ -1,0 +1,376 @@
+"""The dispatcher — the enforcing scheduling loop around the engine.
+
+The engine (:mod:`.engine`) is the reference's eight extension points as
+pure functions; this module is the part of the kube-scheduler *framework*
+the reference relies on to make them bite (``scheduler.go:233,247-267,
+551-587``, ``pod.go:47-78``):
+
+- a real queue ordered by ``queue_less`` (Less, scheduler.go:247-267);
+- Permit that actually **blocks** gang members: a pod whose gang barrier
+  is not reached parks with a deadline instead of binding
+  (scheduler.go:551-575);
+- Unreserve on timeout: when the deadline passes, every gang member is
+  unreserved — bookings reclaimed, ports unmasked, registry records
+  withdrawn — and rejected together (scheduler.go:534-549);
+- unschedulable pods retry with backoff (the framework's requeue);
+- ``groups.gc()`` on a 30 s cadence (scheduler.go:233);
+- **startup replay**: bound pods are re-booked from the registry's
+  requirement records before any new decision (``pod.go:47-78`` re-queues
+  bound pods at informer start; here the records carry everything
+  ``resync_bound`` needs).
+
+The loop core is :meth:`step` — a pure function of (state, now) that
+returns the delay until its next event — so tests drive it with a fake
+clock; :meth:`start` runs the same step on a background thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .. import constants as C
+from ..utils.logger import get_logger
+from .engine import Binding, SchedulerEngine, Unschedulable
+from .labels import PodRequest
+
+log = get_logger("dispatcher")
+
+GC_PERIOD_S = 30.0         # scheduler.go:233
+RETRY_BACKOFF_S = 1.0      # unschedulable requeue delay
+MAX_RESULTS = 4096         # resolved-outcome retention (live pods exempt)
+
+
+@dataclass
+class Outcome:
+    status: str                    # "bound" | "rejected" | "deleted"
+    reason: str = ""
+    binding: Binding | None = None
+
+    def to_dict(self) -> dict:
+        out = {"status": self.status, "reason": self.reason}
+        if self.binding is not None:
+            out.update(node=self.binding.node,
+                       annotations=self.binding.annotations,
+                       env=self.binding.env)
+        return out
+
+
+@dataclass
+class _Parked:
+    pod: PodRequest
+    binding: Binding
+    deadline: float
+
+
+def _binding_of(pod: PodRequest) -> Binding:
+    """Reconstruct the Binding of an already-booked pod (resync/replay
+    paths) so status queries keep the full annotations + env contract."""
+    return Binding(pod.key, pod.node_name, list(pod.chip_ids),
+                   [c.id for c in pod.cells],
+                   [c.cell_type for c in pod.cells], pod.memory, pod.port,
+                   request=pod.request, limit=pod.limit)
+
+
+class Dispatcher:
+    """Owns the engine: all mutations go through this object's lock."""
+
+    def __init__(self, engine: SchedulerEngine, registry=None,
+                 gc_period_s: float = GC_PERIOD_S,
+                 retry_backoff_s: float = RETRY_BACKOFF_S,
+                 clock=time.monotonic, sync=None):
+        self.engine = engine
+        self.registry = registry
+        self.gc_period_s = gc_period_s
+        self.retry_backoff_s = retry_backoff_s
+        self._clock = clock
+        self._sync = sync               # callable(): refresh capacity
+        self._cond = threading.Condition()
+        self._pending: dict[str, PodRequest] = {}
+        self._retry_at: dict[str, float] = {}
+        self._parked: dict[str, _Parked] = {}
+        self._results: dict[str, Outcome] = {}
+        self._last_reason: dict[str, str] = {}
+        self._next_gc = 0.0
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    @property
+    def lock(self) -> threading.Condition:
+        """The lock guarding the engine — external readers (GET /state)
+        must snapshot under it; the loop thread mutates continuously."""
+        return self._cond
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, namespace: str, name: str, labels: dict,
+               uid: str = "") -> str:
+        """Parse + enqueue; raises LabelError on bad labels. Returns the
+        pod key (poll with :meth:`status` / :meth:`outcome`)."""
+        with self._cond:
+            pod = self.engine.submit(namespace, name, labels, uid=uid)
+            parked = self._parked.get(pod.key)
+            if parked is not None:
+                if parked.pod is pod:
+                    return pod.key      # already reserved, awaiting permit
+                # new incarnation (uid change): engine.submit reclaimed the
+                # old booking, so the parked entry's binding is stale —
+                # drop it and requeue the new pod
+                del self._parked[pod.key]
+            if pod.node_name:           # already bound (resubmit of bound)
+                return pod.key
+            self._pending[pod.key] = pod
+            self._results.pop(pod.key, None)
+            self._cond.notify_all()
+            return pod.key
+
+    def delete(self, key: str) -> None:
+        """Pod removal: reclaim + drop from every queue
+        (deletePod, pod.go:91-136)."""
+        with self._cond:
+            self._pending.pop(key, None)
+            self._retry_at.pop(key, None)
+            self._parked.pop(key, None)
+            self.engine.delete_pod(key)
+            self._withdraw(key)
+            self._results[key] = Outcome("deleted")
+            self._cond.notify_all()
+
+    def outcome(self, key: str) -> Outcome | None:
+        with self._cond:
+            return self._results.get(key)
+
+    def status(self, key: str) -> dict:
+        """Current disposition of a pod: resolved outcome, or its queue
+        state ("parked" at the gang barrier / "pending" with the last
+        unschedulable reason / "unknown")."""
+        with self._cond:
+            out = self._results.get(key)
+            if out is not None:
+                return out.to_dict()
+            parked = self._parked.get(key)
+            if parked is not None:
+                return {"status": "parked",
+                        "deadline_s": max(0.0,
+                                          parked.deadline - self._clock())}
+            if key in self._pending:
+                return {"status": "pending",
+                        "reason": self._last_reason.get(key, "")}
+            return {"status": "unknown"}
+
+    def resync(self, namespace: str, name: str, labels: dict,
+               annotations: dict, node: str, uid: str = "") -> None:
+        """Re-book one already-bound pod (the per-pod resync endpoint)."""
+        with self._cond:
+            if self._sync is not None:
+                self._sync()
+            pod = self.engine.resync_bound(namespace, name, labels,
+                                           annotations, node, uid=uid)
+            # drop any queued state for this key: the next step() would
+            # otherwise schedule the STALE PodRequest a second time,
+            # leaking a reservation no delete can ever reach
+            self._pending.pop(pod.key, None)
+            self._retry_at.pop(pod.key, None)
+            self._parked.pop(pod.key, None)
+            self._resolve(pod.key, Outcome("bound",
+                                           binding=_binding_of(pod)))
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self, now: float | None = None) -> float:
+        """One scheduling tick under the lock: GC, expire permits,
+        schedule every ready pod. Returns seconds until the next timed
+        event (inf when purely event-driven)."""
+        with self._cond:
+            return self._step_locked(self._clock() if now is None else now)
+
+    def _step_locked(self, now: float) -> float:
+        if now >= self._next_gc:
+            self.engine.groups.gc()
+            self._next_gc = now + self.gc_period_s
+
+        for key in [k for k, p in self._parked.items() if p.deadline <= now]:
+            if key in self._parked:     # may be gone via gang rejection
+                log.info("gang permit timeout for %s", key)
+                self._reject_gang(self._parked[key].pod,
+                                  "gang permit timeout")
+
+        synced = False
+        progressed = True
+        while progressed:
+            progressed = False
+            key = self._pick(now)
+            if key is not None:
+                if not synced and self._sync is not None:
+                    # once per pass, not per pod (set_fleet skips its
+                    # rebuild when the capacity snapshot is unchanged)
+                    try:
+                        self._sync()
+                    except Exception as e:
+                        log.warning("capacity sync failed: %s", e)
+                    synced = True
+                pod = self._pending.pop(key)
+                self._retry_at.pop(key, None)  # stale entries would make
+                # the loop's next-event delay 0 forever (busy spin)
+                self._cycle(pod, now)
+                progressed = True
+
+        nxt = self._next_gc
+        for parked in self._parked.values():
+            nxt = min(nxt, parked.deadline)
+        for t in self._retry_at.values():
+            nxt = min(nxt, t)
+        return max(0.0, nxt - now)
+
+    def _pick(self, now: float) -> str | None:
+        """Highest-priority ready pod per queue_less (the Less-ordered
+        active queue, scheduler.go:247-267)."""
+        best: str | None = None
+        for key, pod in self._pending.items():
+            if self._retry_at.get(key, 0.0) > now:
+                continue
+            if best is None or self.engine.queue_less(pod,
+                                                      self._pending[best]):
+                best = key
+        return best
+
+    def _cycle(self, pod: PodRequest, now: float) -> None:
+        ok, msg = self.engine.pre_filter(pod)
+        if not ok:
+            self._requeue(pod, now, msg)
+            return
+        try:
+            binding = self.engine.schedule(pod)
+        except Unschedulable as e:
+            self._requeue(pod, now, str(e))
+            return
+        if self.registry is not None and pod.needs_tpu:
+            from ..telemetry.aggregator import publish_binding
+
+            publish_binding(self.registry, pod, binding)
+        decision, timeout_s = self.engine.permit(pod)
+        if decision == "wait":
+            self._parked[pod.key] = _Parked(pod, binding, now + timeout_s)
+            log.info("%s parked at gang barrier (%.1fs)", pod.key, timeout_s)
+            return
+        self._resolve(pod.key, Outcome("bound", binding=binding))
+        # the pod completing the barrier releases every parked member
+        # (Allow all waiting group members, scheduler.go:577-584)
+        if pod.group_name:
+            for key in [k for k, p in self._parked.items()
+                        if p.pod.group_key == pod.group_key]:
+                parked = self._parked.pop(key)
+                self._resolve(key, Outcome("bound", binding=parked.binding))
+
+    def _requeue(self, pod: PodRequest, now: float, reason: str) -> None:
+        self._pending[pod.key] = pod
+        self._retry_at[pod.key] = now + self.retry_backoff_s
+        self._last_reason[pod.key] = reason
+        log.debug("%s unschedulable, retrying in %.1fs: %s",
+                  pod.key, self.retry_backoff_s, reason)
+
+    def _reject_gang(self, pod: PodRequest, reason: str) -> None:
+        """Unreserve + reject every member (Unreserve, scheduler.go:534-549
+        — the gang fails together). Members are fully deleted from the
+        engine: a rejected member kept in pod_status would be a phantom
+        sibling that lets a lone resubmit pass pre_filter forever."""
+        members = [pod.key] + self.engine.unreserve(pod)
+        for key in members:
+            self.engine.delete_pod(key)   # reclaim + group expiry
+            self._pending.pop(key, None)
+            self._retry_at.pop(key, None)
+            self._parked.pop(key, None)
+            self._withdraw(key)
+            self._resolve(key, Outcome("rejected", reason))
+
+    def _withdraw(self, key: str) -> None:
+        if self.registry is None:
+            return
+        try:
+            from ..telemetry.aggregator import withdraw
+
+            withdraw(self.registry, key)
+        except Exception as e:
+            log.warning("withdraw %s failed: %s", key, e)
+
+    def _resolve(self, key: str, outcome: Outcome) -> None:
+        self._results.pop(key, None)   # re-insert at the back (LRU order)
+        self._results[key] = outcome
+        self._last_reason.pop(key, None)
+        # bound retention: without eviction a long-running scheduler keeps
+        # an Outcome (with its Binding) for every pod EVER seen
+        scan = len(self._results) - MAX_RESULTS
+        for old in list(self._results):
+            if scan <= 0:
+                break
+            scan -= 1
+            if old not in self.engine.pod_status:   # never evict live pods
+                del self._results[old]
+        self._cond.notify_all()
+
+    # -- startup replay ----------------------------------------------------
+
+    def replay_bound(self) -> list[str]:
+        """Re-book every requirement record from the registry (crash
+        recovery; the informer's bound-pod re-queue, pod.go:47-78). Call
+        once, after capacity is synced and before start()."""
+        if self.registry is None:
+            return []
+        replayed = []
+        with self._cond:
+            for key, rec in sorted(self.registry.pods().items()):
+                namespace, _, name = key.partition("/")
+                labels = {C.POD_TPU_REQUEST: rec.get("request", "0"),
+                          C.POD_TPU_LIMIT: rec.get("limit", "0")}
+                if rec.get("priority", "0") not in ("", "0"):
+                    labels[C.POD_PRIORITY] = rec["priority"]
+                if rec.get("group_name"):
+                    labels[C.POD_GROUP_NAME] = rec["group_name"]
+                    labels[C.POD_GROUP_HEADCOUNT] = rec.get("headcount", "0")
+                    labels[C.POD_GROUP_THRESHOLD] = rec.get("threshold", "0")
+                annotations = {
+                    C.POD_TPU_CHIP_ID: rec.get("chip_id", ""),
+                    C.POD_TPU_MEMORY: rec.get("memory", "0"),
+                    C.POD_MANAGER_PORT: rec.get("port", "0"),
+                    C.POD_CELL_ID: rec.get("cell_id", ""),
+                }
+                try:
+                    pod = self.engine.resync_bound(
+                        namespace, name, labels, annotations,
+                        rec.get("node", ""), uid=rec.get("uid", ""))
+                    self._results[key] = Outcome("bound",
+                                                 binding=_binding_of(pod))
+                    replayed.append(key)
+                except Exception as e:
+                    log.error("replay of %s failed: %s", key, e)
+        if replayed:
+            log.info("replayed %d bound pods from the registry",
+                     len(replayed))
+        return replayed
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Dispatcher":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dispatcher")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                delay = self._step_locked(self._clock())
+                # cap the sleep so wall-clock deadlines stay honored even
+                # when no notify arrives
+                self._cond.wait(min(delay, 0.2))
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -116,6 +116,8 @@ class SchedulerEngine:
         self.permit_wait_base_s = permit_wait_base_s
         self.mesh_shape = mesh_shape
         self._clock = clock
+        self._fleet_snapshot: tuple | None = None
+        self.rebuild_count = 0   # topology rebuilds since start
         if config is not None:
             self._build(config)
 
@@ -136,7 +138,8 @@ class SchedulerEngine:
         every new node and re-books live workloads onto the fresh trees —
         the same replay the crash resync performs."""
         known = node_name in self.chips_by_node
-        by_model: dict[str, list[ChipInfo]] = {}
+        self._fleet_snapshot = None   # per-node edits invalidate the
+        by_model: dict[str, list[ChipInfo]] = {}  # set_fleet no-op check
         for chip in chips:
             by_model.setdefault(chip.model, []).append(chip)
         changed = not known or self.chips_by_node[node_name] != by_model
@@ -160,7 +163,18 @@ class SchedulerEngine:
         """Batch inventory update: one rebuild for the whole fleet instead
         of one per node (the full-sync path). Nodes absent from *fleet*
         are removed — a departed collector's capacity must not stay
-        schedulable (port bitmaps are kept so masks survive a flap)."""
+        schedulable (port bitmaps are kept so masks survive a flap).
+
+        No-op when nothing changed: the service syncs capacity before
+        every scheduling pass, and in auto-config mode an unconditional
+        rebuild would reconstruct all cell trees and re-book every live
+        pod per decision — O(cluster x pods) for a pod placed."""
+        snapshot = tuple(sorted(
+            (node, healthy, tuple(sorted(chips, key=lambda c: c.chip_id)))
+            for node, (chips, healthy) in fleet.items()))
+        if snapshot == self._fleet_snapshot:
+            return
+        self._fleet_snapshot = snapshot
         for gone in set(self.chips_by_node) - set(fleet):
             del self.chips_by_node[gone]
             self.node_health.pop(gone, None)
@@ -184,6 +198,7 @@ class SchedulerEngine:
                                 self.node_health[node_name])
 
     def _rebuild_auto_config(self) -> None:
+        self.rebuild_count += 1
         all_chips = [c for models in self.chips_by_node.values()
                      for chips_ in models.values() for c in chips_]
         self._build(config_from_chips(all_chips))
@@ -204,6 +219,7 @@ class SchedulerEngine:
                     reserve_resource(cell, compute, memory)
 
     def set_node_health(self, node_name: str, healthy: bool) -> None:
+        self._fleet_snapshot = None
         self.node_health[node_name] = healthy
         set_node_status(self.free_list, self.chips_by_node, self.leaf_cells,
                         node_name, healthy)
@@ -426,7 +442,12 @@ class SchedulerEngine:
         """Re-book an already-bound workload after an engine restart from
         the annotations written at reserve time (processBoundPod/
         setPodStatus, pod.go:547-617) — state reconstruction without any
-        persisted store."""
+        persisted store. Idempotent: a pod already booked (startup
+        replay, then a per-pod /resync of the same key) is reclaimed
+        first, never double-booked."""
+        cached = self.pod_status.get(f"{namespace}/{name}")
+        if cached is not None:
+            self._reclaim(cached)
         pod = parse_pod_labels(namespace, name, labels, uid=uid,
                                node_name=node_name)
         pod.timestamp = self._clock()

@@ -2,20 +2,29 @@
 
 The reference compiles its engine into a full kube-scheduler binary
 (``cmd/kubeshare-scheduler/main.go:26-37``); the TPU-native engine is
-k8s-independent, so the deployable unit is this HTTP service: it syncs
-capacity from the telemetry registry before every decision (fresh reads —
-no PromQL window), schedules one pod per request, publishes the binding
-back to the registry for the node agents, and resyncs bound pods on
-restart (the crash recovery of ``pod.go:528-582``).
+k8s-independent, so the deployable unit is this HTTP service wrapped
+around the :class:`~.dispatcher.Dispatcher` — the enforcing loop that
+owns the Less-ordered queue, the gang Permit barrier with
+timeout-unreserve, the unschedulable retry backoff, the 30 s group GC,
+and the startup replay of bound pods from the registry.
 
 API (JSON):
 
-- ``POST /schedule``  {"namespace","name","labels"{,"uid"}} → binding
-  (annotations + env) or 409 with the unschedulable reason
+- ``POST /schedule``  {"namespace","name","labels"{,"uid"}} → one
+  synchronous scheduling attempt:
+  200 bound (annotations + env) · 202 parked at the gang barrier or
+  pending with the unschedulable reason (poll ``GET /pods/...``) ·
+  409 rejected (bad labels / gang rejection)
+- ``GET  /pods/<ns>/<name>``  current disposition of a pod
 - ``POST /resync``    {"namespace","name","labels","annotations","node"}
 - ``DELETE /pods/<ns>/<name>``
 - ``GET  /state``     engine snapshot (nodes, leaves, pods)
 - ``GET  /healthz``
+
+The creator of a gang member is NOT blocked while the gang forms (the
+reference's Permit blocks a scheduler goroutine, never the pod's
+creator): ``/schedule`` returns 202 for a parked member and the caller
+polls — or simply keeps submitting the rest of the gang.
 """
 
 from __future__ import annotations
@@ -24,9 +33,10 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..telemetry.aggregator import publish_binding, sync_engine_from_registry, withdraw
+from ..telemetry.aggregator import sync_engine_from_registry
 from ..telemetry.registry import RegistryClient, TelemetryRegistry
 from ..utils.logger import get_logger
+from .dispatcher import Dispatcher
 from .engine import SchedulerEngine, Unschedulable
 from .labels import LabelError
 
@@ -35,48 +45,51 @@ log = get_logger("schedsvc")
 
 class SchedulerService:
     def __init__(self, engine: SchedulerEngine,
-                 registry: RegistryClient | TelemetryRegistry):
+                 registry: RegistryClient | TelemetryRegistry,
+                 replay: bool = True, **dispatcher_kw):
         self.engine = engine
         self.registry = registry
-        self._lock = threading.Lock()  # one scheduling cycle at a time
+        self.dispatcher = Dispatcher(
+            engine, registry,
+            sync=lambda: sync_engine_from_registry(engine, registry),
+            **dispatcher_kw)
+        self._replay = replay
         self._server: ThreadingHTTPServer | None = None
 
     # -- operations --------------------------------------------------------
 
     def schedule(self, namespace: str, name: str, labels: dict,
-                 uid: str = "") -> dict:
-        with self._lock:
-            sync_engine_from_registry(self.engine, self.registry)
-            pod = self.engine.submit(namespace, name, labels, uid=uid)
-            binding = self.engine.schedule(pod)
-            if pod.needs_tpu:
-                publish_binding(self.registry, pod, binding)
-            decision, timeout_s = self.engine.permit(pod)
-            return {
-                "node": binding.node,
-                "annotations": binding.annotations,
-                "env": binding.env,
-                "permit": decision,
-                "permit_timeout_s": timeout_s,
-            }
+                 uid: str = "") -> tuple[int, dict]:
+        """Submit + one synchronous dispatch attempt. Returns
+        (http_status, body)."""
+        key = self.dispatcher.submit(namespace, name, labels, uid=uid)
+        self.dispatcher.step()
+        status = self.dispatcher.status(key)
+        state = status.get("status")
+        if state == "bound":
+            return 200, status
+        if state in ("parked", "pending"):
+            return 202, status
+        return 409, status
+
+    def pod_status(self, key: str) -> dict:
+        return self.dispatcher.status(key)
 
     def delete(self, key: str) -> None:
-        with self._lock:
-            self.engine.delete_pod(key)
-            try:
-                withdraw(self.registry, key)
-            except Exception as e:
-                log.warning("withdraw %s failed: %s", key, e)
+        self.dispatcher.delete(key)
 
     def resync(self, namespace: str, name: str, labels: dict,
-               annotations: dict, node: str) -> None:
-        with self._lock:
-            sync_engine_from_registry(self.engine, self.registry)
-            self.engine.resync_bound(namespace, name, labels, annotations,
-                                     node)
+               annotations: dict, node: str, uid: str = "") -> None:
+        self.dispatcher.resync(namespace, name, labels, annotations, node,
+                               uid=uid)
 
     def state(self) -> dict:
         eng = self.engine
+        with self.dispatcher.lock:  # the loop thread mutates continuously
+            return self._state_locked(eng)
+
+    @staticmethod
+    def _state_locked(eng: SchedulerEngine) -> dict:
         return {
             "nodes": eng.nodes,
             "leaves": {cid: {"available": leaf.available,
@@ -93,6 +106,15 @@ class SchedulerService:
 
     def serve(self, host: str = "127.0.0.1",
               port: int = 0) -> ThreadingHTTPServer:
+        # startup order matters: capacity first, bound-pod replay second,
+        # only then the enforcement loop + new decisions (pod.go:47-78)
+        if self._replay:
+            try:
+                sync_engine_from_registry(self.engine, self.registry)
+                self.dispatcher.replay_bound()
+            except Exception as e:
+                log.warning("startup replay skipped: %s", e)
+        self.dispatcher.start()
         svc = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -116,21 +138,26 @@ class SchedulerService:
                     return self._reply(200, {"ok": True})
                 if self.path == "/state":
                     return self._reply(200, svc.state())
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[0] == "pods":
+                    return self._reply(
+                        200, svc.pod_status(f"{parts[1]}/{parts[2]}"))
                 self._reply(404, {"error": "not found"})
 
             def do_POST(self):
                 try:
                     body = self._body()
                     if self.path == "/schedule":
-                        result = svc.schedule(body["namespace"], body["name"],
-                                              body.get("labels", {}),
-                                              body.get("uid", ""))
-                        return self._reply(200, result)
+                        code, result = svc.schedule(
+                            body["namespace"], body["name"],
+                            body.get("labels", {}), body.get("uid", ""))
+                        return self._reply(code, result)
                     if self.path == "/resync":
                         svc.resync(body["namespace"], body["name"],
                                    body.get("labels", {}),
                                    body.get("annotations", {}),
-                                   body.get("node", ""))
+                                   body.get("node", ""),
+                                   body.get("uid", ""))
                         return self._reply(200, {"ok": True})
                 except (LabelError, Unschedulable) as e:
                     return self._reply(409, {"error": str(e)})
@@ -160,6 +187,7 @@ class SchedulerService:
         return self._server.server_address[1]
 
     def close(self) -> None:
+        self.dispatcher.stop()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

@@ -25,7 +25,10 @@ def requirement_record(pod: PodRequest, binding: Binding) -> dict:
     """The ``tpu_requirement`` label set (aggregator.go:22-39 parity)."""
     return {
         "node": binding.node,
+        "uid": pod.uid,
         "group_name": pod.group_name,
+        "headcount": str(pod.headcount),
+        "threshold": str(pod.threshold),
         "priority": str(pod.priority),
         "request": str(pod.request),
         "limit": str(pod.limit),

@@ -1,0 +1,276 @@
+"""Dispatcher tests — the enforcing loop the reference gets from the
+kube-scheduler framework (Less queue, blocking Permit, timeout
+Unreserve, group GC cadence, startup replay). Driven with a fake clock
+through step() for determinism."""
+
+import pytest
+
+from kubeshare_tpu import constants as C
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+from kubeshare_tpu.scheduler.service import SchedulerService
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_engine(hosts=1, mesh=(2, 2), clock=None):
+    eng = SchedulerEngine(**({"clock": clock} if clock else {}))
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=hosts, mesh=mesh).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    return eng
+
+
+def shared(request="0.5", limit="1.0", **extra):
+    labels = {C.POD_TPU_REQUEST: request, C.POD_TPU_LIMIT: limit}
+    labels.update(extra)
+    return labels
+
+
+def gang(name, headcount=3, threshold=1.0, priority="10", **kw):
+    return shared(**{C.POD_GROUP_NAME: name,
+                     C.POD_GROUP_HEADCOUNT: str(headcount),
+                     C.POD_GROUP_THRESHOLD: str(threshold),
+                     C.POD_PRIORITY: priority}, **kw)
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def disp(clock):
+    eng = make_engine(clock=clock)
+    d = Dispatcher(eng, TelemetryRegistry(), clock=clock,
+                   retry_backoff_s=1.0)
+    yield d
+
+
+def test_regular_pod_binds_in_one_step(disp, clock):
+    key = disp.submit("ns", "p", shared())
+    assert disp.outcome(key) is None
+    disp.step()
+    out = disp.outcome(key)
+    assert out.status == "bound" and out.binding.node == "tpu-host-0"
+    assert disp.registry.pods()[key]["node"] == "tpu-host-0"
+
+
+def test_trickle_in_gang_held_then_released(clock):
+    """A gang member that reserved is HELD at the permit barrier while
+    its sibling waits for capacity, and released the moment the barrier
+    completes (scheduler.go:551-587)."""
+    eng = make_engine(mesh=(2,), clock=clock)  # two whole-chip leaves
+    disp = Dispatcher(eng, TelemetryRegistry(), clock=clock,
+                      retry_backoff_s=1.0)
+    blocker = disp.submit("ns", "blocker", shared("1", "1"))
+    disp.step()
+    assert disp.outcome(blocker).status == "bound"
+
+    # gang of 2 whole-chip members; only one leaf is free
+    k1 = disp.submit("ns", "g-0", gang("g", headcount=2, request="1",
+                                       limit="1"))
+    k2 = disp.submit("ns", "g-1", gang("g", headcount=2, request="1",
+                                       limit="1"))
+    disp.step()
+    statuses = {disp.status(k1)["status"], disp.status(k2)["status"]}
+    assert statuses == {"parked", "pending"}  # one reserved+held, one queued
+    parked_key = k1 if disp.status(k1)["status"] == "parked" else k2
+
+    disp.delete(blocker)                       # capacity frees
+    clock.t += 1.5                             # past the retry backoff
+    disp.step()
+    for k in (k1, k2):
+        out = disp.outcome(k)
+        assert out is not None and out.status == "bound", disp.status(k)
+    assert disp.outcome(parked_key).binding is not None
+    assert all(l.available == 0.0 for l in eng.leaf_cells.values())
+
+
+def test_gang_timeout_rejects_all_and_reclaims(clock):
+    """Permit deadline passes → the WHOLE gang is unreserved: bookings
+    reclaimed, ports unmasked, registry records withdrawn
+    (scheduler.go:534-549)."""
+    eng = make_engine(mesh=(1,), clock=clock)  # one leaf: sibling starves
+    disp = Dispatcher(eng, TelemetryRegistry(), clock=clock,
+                      retry_backoff_s=1.0)
+    k1 = disp.submit("ns", "g-0", gang("g", headcount=2, request="0.5"))
+    k2 = disp.submit("ns", "g-1", gang("g", headcount=2, request="0.6"))
+    disp.step()
+    # 0.5 reserved and parked; 0.6 cannot fit next to it (1.1 > 1.0)
+    assert disp.status(k1)["status"] == "parked"
+    assert disp.status(k2)["status"] == "pending"
+    assert disp.registry.pods()  # the parked member was published
+
+    clock.t += 2.0 * 2 + 1.0  # past permit_wait_base_s * headcount
+    disp.step()
+    for k in (k1, k2):
+        out = disp.outcome(k)
+        assert out is not None and out.status == "rejected"
+        assert "timeout" in out.reason
+    # everything reclaimed: leaves whole-free, ports unmasked, registry empty
+    assert all(l.available == l.leaf_cell_number
+               for l in disp.engine.leaf_cells.values())
+    assert disp.engine.ports["tpu-host-0"].count() == 1  # only the base mask
+    assert disp.registry.pods() == {}
+
+
+def test_queue_orders_by_priority_then_time(disp, clock):
+    """Higher-priority pods jump the queue (Less, scheduler.go:247-267):
+    with one leaf left, the high-priority pod submitted later wins it."""
+    eng = disp.engine
+    # fill 3 of 4 leaves
+    for i in range(3):
+        disp.submit("ns", f"fill-{i}", shared("1", "1"))
+    disp.step()
+    lo = disp.submit("ns", "lo", shared("1", "1", **{C.POD_PRIORITY: "1"}))
+    hi = disp.submit("ns", "hi", shared("1", "1", **{C.POD_PRIORITY: "90"}))
+    disp.step()
+    assert disp.outcome(hi).status == "bound"
+    assert disp.status(lo)["status"] == "pending"  # waits for capacity
+
+
+def test_unschedulable_retries_after_capacity_frees(disp, clock):
+    blocker = disp.submit("ns", "blocker", shared("1", "1"))
+    disp.step()
+    assert disp.outcome(blocker).status == "bound"
+    big = disp.submit("ns", "big", shared("4", "4"))  # needs all 4 leaves
+    disp.step()
+    assert disp.status(big)["status"] == "pending"
+    disp.delete(blocker)
+    clock.t += 1.5
+    disp.step()
+    assert disp.outcome(big).status == "bound"
+
+
+def test_group_gc_runs_on_cadence(disp, clock):
+    k = disp.submit("ns", "g-0", gang("g", headcount=1, threshold=1.0))
+    disp.step()
+    assert disp.outcome(k).status == "bound"
+    disp.delete(k)
+    assert len(disp.engine.groups) == 1  # expired, not yet collected
+    clock.t += 700.0  # past group expiration (600s) and gc cadence
+    disp.step()
+    assert len(disp.engine.groups) == 0
+
+
+def test_kill_and_restart_rebooks_identically():
+    """Crash recovery: a NEW engine + dispatcher on the same registry
+    replays the bound pods into the identical booking state."""
+    registry = TelemetryRegistry()
+    chips = FakeTopology(hosts=1, mesh=(2, 2)).chips()
+    registry.put_capacity("tpu-host-0", [c.to_labels() for c in chips])
+
+    svc = SchedulerService(SchedulerEngine(), registry)
+    svc.serve()
+    try:
+        code, a = svc.schedule("ns", "a", shared("0.5", "1.0"), uid="U-a")
+        assert code == 200
+        code, b = svc.schedule("ns", "b", shared(
+            "0.25", "1.0", **{C.POD_TPU_MEMORY: str(10 << 30)}), uid="U-b")
+        assert code == 200
+        state1 = svc.state()
+    finally:
+        svc.close()
+
+    svc2 = SchedulerService(SchedulerEngine(), registry)  # replay=True
+    svc2.serve()
+    try:
+        state2 = svc2.state()
+        assert state2["leaves"] == state1["leaves"]
+        for key in ("ns/a", "ns/b"):
+            assert state2["pods"][key]["node"] == state1["pods"][key]["node"]
+            assert state2["pods"][key]["chips"] == state1["pods"][key]["chips"]
+            assert state2["pods"][key]["port"] == state1["pods"][key]["port"]
+        # the replayed port is masked: a new pod must get a fresh port
+        code, c = svc2.schedule("ns", "c", shared())
+        assert code == 200
+        ports = {state2["pods"][k]["port"] for k in ("ns/a", "ns/b")}
+        assert c["annotations"][C.POD_MANAGER_PORT] not in {
+            str(p) for p in ports}
+        # uid survives the replay: a resubmit with the ORIGINAL uid is the
+        # same incarnation — full binding returned, booking untouched
+        state3 = svc2.state()
+        code, again = svc2.schedule("ns", "a", shared("0.5", "1.0"),
+                                    uid="U-a")
+        assert code == 200 and again["status"] == "bound"
+        assert again["annotations"] == a["annotations"]
+        assert svc2.state()["leaves"] == state3["leaves"]
+    finally:
+        svc2.close()
+
+
+def test_uid_change_while_parked_requeues_fresh(clock):
+    """A gang member recreated (new uid) while parked must drop the stale
+    reservation and requeue — resolving the old binding would point at
+    reclaimed chips/ports."""
+    eng = make_engine(mesh=(1,), clock=clock)
+    disp = Dispatcher(eng, TelemetryRegistry(), clock=clock)
+    k1 = disp.submit("ns", "g-0", gang("g", headcount=2, request="0.5"),
+                     uid="A")
+    disp.submit("ns", "g-1", gang("g", headcount=2, request="0.6"), uid="A2")
+    disp.step()
+    assert disp.status(k1)["status"] == "parked"
+    leaf = next(iter(eng.leaf_cells.values()))
+    assert leaf.available == 0.5
+
+    disp.submit("ns", "g-0", gang("g", headcount=2, request="0.5"), uid="B")
+    assert disp.status(k1)["status"] == "pending"   # requeued, not parked
+    assert leaf.available == 1.0                    # old booking reclaimed
+
+
+def test_unchanged_capacity_syncs_do_not_rebuild():
+    """set_fleet must be a no-op while the capacity snapshot is unchanged
+    — in auto-config mode every rebuild reconstructs all cell trees and
+    re-books every live pod (round-2 weak #3)."""
+    registry = TelemetryRegistry()
+    chips = FakeTopology(hosts=2, mesh=(2, 2)).chips()
+    by_host: dict = {}
+    for c in chips:
+        by_host.setdefault(c.host, []).append(c)
+    for host, host_chips in by_host.items():
+        registry.put_capacity(host, [c.to_labels() for c in host_chips])
+
+    svc = SchedulerService(SchedulerEngine(), registry)
+    svc.serve()
+    try:
+        base = svc.engine.rebuild_count
+        for i in range(20):
+            code, _ = svc.schedule("ns", f"p{i}", shared("0.25", "1.0"))
+            assert code == 200
+        assert svc.engine.rebuild_count == base  # zero rebuilds, 20 pods
+        # a real inventory change still rebuilds
+        registry.drop_capacity(sorted(by_host)[1])
+        code, _ = svc.schedule("ns", "px", shared("0.25", "1.0"))
+        assert code == 200
+        assert svc.engine.rebuild_count == base + 1
+    finally:
+        svc.close()
+
+
+def test_gang_replay_restores_group(disp, clock):
+    """Replayed gang members re-form their group so a post-restart
+    delete/permit works on the right min_available."""
+    for i in range(2):
+        disp.submit("ns", f"g-{i}", gang("g", headcount=2))
+    disp.step()
+    recs = disp.registry.pods()
+    assert len(recs) == 2 and all(r["headcount"] == "2" for r in recs.values())
+
+    eng2 = make_engine()
+    d2 = Dispatcher(eng2, disp.registry)
+    replayed = d2.replay_bound()
+    assert sorted(replayed) == ["ns/g-0", "ns/g-1"]
+    pod = eng2.pod_status["ns/g-0"]
+    assert pod.group_name == "g" and pod.min_available == 2
+    assert d2.outcome("ns/g-0").status == "bound"

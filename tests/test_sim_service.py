@@ -126,7 +126,7 @@ def test_service_schedules_and_publishes(service):
         "labels": {C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"}})
     assert status == 200
     assert result["node"] == "tpu-host-0"
-    assert result["permit"] == "allow"
+    assert result["status"] == "bound"
     assert C.ENV_VISIBLE_CHIPS in result["env"]
     assert registry.pods()["ns/p"]["node"] == "tpu-host-0"
 
@@ -144,10 +144,15 @@ def test_service_rejects_bad_labels_and_unschedulable(service):
         "namespace": "ns", "name": "bad",
         "labels": {C.POD_TPU_REQUEST: "1.0", C.POD_TPU_LIMIT: "0.5"}})
     assert status == 409 and "tpu_limit" in err["error"]
+    # an infeasible pod stays Pending with retry backoff (the framework's
+    # requeue), not rejected — 202 + reason, pollable at /pods/<key>
     status, err = http("POST", svc.port, "/schedule", {
         "namespace": "ns", "name": "big",
         "labels": {C.POD_TPU_REQUEST: "5", C.POD_TPU_LIMIT: "5"}})
-    assert status == 409
+    assert status == 202
+    assert err["status"] == "pending" and err["reason"]
+    status, disp = http("GET", svc.port, "/pods/ns/big")
+    assert status == 200 and disp["status"] == "pending"
 
 
 def test_service_resync(service):
