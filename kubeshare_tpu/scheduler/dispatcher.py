@@ -134,8 +134,7 @@ class Dispatcher:
             self._parked.pop(key, None)
             self.engine.delete_pod(key)
             self._withdraw(key)
-            self._results[key] = Outcome("deleted")
-            self._cond.notify_all()
+            self._resolve(key, Outcome("deleted"))  # evicts + drops reason
 
     def outcome(self, key: str) -> Outcome | None:
         with self._cond:
@@ -248,7 +247,14 @@ class Dispatcher:
         if self.registry is not None and pod.needs_tpu:
             from ..telemetry.aggregator import publish_binding
 
-            publish_binding(self.registry, pod, binding)
+            try:
+                publish_binding(self.registry, pod, binding)
+            except Exception as e:
+                # transient registry failure must not kill the loop thread
+                # nor leak the fresh reservation — roll back and retry
+                self.engine.unreserve(pod)
+                self._requeue(pod, now, f"binding publish failed: {e}")
+                return
         decision, timeout_s = self.engine.permit(pod)
         if decision == "wait":
             self._parked[pod.key] = _Parked(pod, binding, now + timeout_s)
@@ -362,7 +368,12 @@ class Dispatcher:
             with self._cond:
                 if self._stop:
                     return
-                delay = self._step_locked(self._clock())
+                try:
+                    delay = self._step_locked(self._clock())
+                except Exception:
+                    # the loop thread must survive anything a cycle throws
+                    log.exception("dispatcher step failed")
+                    delay = self.retry_backoff_s
                 # cap the sleep so wall-clock deadlines stay honored even
                 # when no notify arrives
                 self._cond.wait(min(delay, 0.2))

@@ -129,6 +129,17 @@ def select_cells(free_list, node_name: str, pod: PodRequest,
                 return chosen
         return []
     leaves = node_leaf_cells(free_list, node_name, pod.model)
+    if pod.multi_chip:
+        # ICI shape-aware allocation (SURVEY §7.3.4): a mesh workload gets
+        # a CONTIGUOUS torus block, not the top-priority scatter — XLA
+        # collectives ride neighbor links. Mesh shape comes from
+        # discovery; cells without coordinates fall through to the
+        # priority ordering below.
+        from .meshselect import select_submesh
+
+        block = select_submesh(leaves, int(pod.request), group_cells)
+        if block is not None:
+            return block
     scored: list[tuple[float, Cell]] = []
     for leaf in leaves:
         prio = float(chip_priority.get(leaf.cell_type, leaf.priority))
