@@ -148,8 +148,13 @@ class ProxyClient:
     """Connection to a :class:`~.proxy.ChipProxy` for one named client."""
 
     def __init__(self, host: str, port: int, name: str, request: float,
-                 limit: float, memory: int = 0, timeout: float | None = None):
+                 limit: float, memory: int = 0, timeout: float | None = None,
+                 chunk_bytes: int = 64 << 20):
         self.name = name
+        #: transfer slab size for put/get; arrays whose serialized form
+        #: exceeds it stream in slices, so checkpoint-sized buffers cross a
+        #: wire whose frame cap is far smaller than the buffer.
+        self.chunk_bytes = chunk_bytes
         self._conn = protocol.Connection(host, port, timeout=timeout)
         reply, _ = self._conn.call({
             "op": "register", "name": name, "request": request,
@@ -159,18 +164,61 @@ class ProxyClient:
 
     # -- buffers -------------------------------------------------------------
 
+    def _chunk(self) -> int:
+        # Re-read MAX_FRAME at call time: the headroom must track whatever
+        # cap the wire actually enforces (tests shrink it to prove the
+        # sliced path; deployments may lower it for memory hygiene).
+        return max(1, min(self.chunk_bytes, protocol.MAX_FRAME - 4096))
+
     def put(self, array) -> RemoteBuffer:
         arr = np.asarray(array)
-        reply, _ = self._conn.call({"op": "put", "name": self.name},
-                                   blob=dump_array(arr))
+        blob = dump_array(arr)
+        chunk = self._chunk()
+        if len(blob) <= chunk:
+            reply, _ = self._conn.call({"op": "put", "name": self.name},
+                                       blob=blob)
+        else:
+            reply0, _ = self._conn.call({"op": "put_begin",
+                                         "name": self.name,
+                                         "nbytes": len(blob)})
+            sid = reply0["staging"]
+            try:
+                for off in range(0, len(blob), chunk):
+                    self._conn.call({"op": "put_chunk", "name": self.name,
+                                     "staging": sid, "offset": off},
+                                    blob=blob[off:off + chunk])
+                reply, _ = self._conn.call({"op": "put_commit",
+                                            "name": self.name,
+                                            "staging": sid})
+            except RuntimeError:
+                # Remote-side refusal (HBM cap, bad chunk): drop the staged
+                # bytes; the connection itself is still in sync.
+                self._conn.call({"op": "put_abort", "name": self.name,
+                                 "staging": sid})
+                raise
         return RemoteBuffer(reply["handle"], tuple(reply["shape"]),
                             reply["dtype"])
 
     def get(self, buf: RemoteBuffer) -> np.ndarray:
-        _, blob = self._conn.call({"op": "get", "name": self.name,
-                                   "handle": buf.handle})
+        chunk = self._chunk()
+        reply, blob = self._conn.call({"op": "get", "name": self.name,
+                                       "handle": buf.handle,
+                                       "offset": 0, "length": chunk})
         assert blob is not None
-        return load_array(blob)
+        total = int(reply["total"])
+        if len(blob) >= total:
+            return load_array(blob)
+        raw = bytearray(total)
+        raw[:len(blob)] = blob
+        off = len(blob)
+        while off < total:
+            _, part = self._conn.call({"op": "get", "name": self.name,
+                                       "handle": buf.handle,
+                                       "offset": off, "length": chunk})
+            assert part
+            raw[off:off + len(part)] = part
+            off += len(part)
+        return load_array(bytes(raw))
 
     def free(self, *bufs) -> None:
         import jax

@@ -83,6 +83,11 @@ class _Session:
     last_end_ms: float = 0.0      # when the last execution finished
     exec_count: int = 0
     exec_ms_total: float = 0.0
+    # Chunked-transfer state (connection-serialized like everything else):
+    # one cached serialized blob for sliced `get`, and in-flight staged
+    # uploads for `put_begin`/`put_chunk`/`put_commit`.
+    fetch_cache: tuple[int, bytes] | None = None
+    staging: dict[int, tuple[int, bytearray]] = field(default_factory=dict)
 
     def fresh_id(self) -> int:
         self.next_id += 1
@@ -292,27 +297,64 @@ class ChipProxy:
         sess = self._session(name)
 
         if op == "put":
-            arr = load_array(state["blob"])
-            # Pre-check with the host-side size so an over-cap upload is
-            # refused before touching the device at all...
-            self._charge(sess, arr.nbytes)
-            sess.hbm_used -= arr.nbytes
-            buf = self._jax.device_put(arr, self.device)
-            try:
-                # ...then account the *device* buffer: device_put
-                # canonicalizes dtypes (e.g. int64→int32 with x64 off), so
-                # charging the host size would leak on every put/free cycle.
-                self._charge(sess, int(buf.nbytes))
-            except HBMError:
-                del buf
-                raise
-            handle = sess.fresh_id()
-            sess.buffers[handle] = buf
-            return {"ok": True, "handle": handle,
-                    "shape": list(buf.shape), "dtype": str(buf.dtype)}
+            return self._put_array(sess, load_array(state["blob"]))
+
+        if op == "put_begin":
+            # Chunked upload: stage the serialized (.npy) stream host-side
+            # across calls, then materialize at commit. Lets a checkpoint-
+            # sized array cross a wire whose frame cap is far smaller
+            # (≙ the hook's repeated cudaMemcpy slabs in the reference).
+            total = int(req["nbytes"])
+            if not 0 < total <= (64 << 30):
+                raise ValueError(f"bad staged size {total}")
+            if sess.memory_cap and (
+                    sess.hbm_used + total - 4096 > sess.memory_cap):
+                # The .npy stream is ~nbytes + a <4 KiB header: an upload
+                # that cannot fit under the HBM cap should be refused here,
+                # not after the client has streamed gigabytes of chunks.
+                raise HBMError(
+                    f"{sess.name}: staged put of {total} bytes would exceed "
+                    f"HBM cap ({sess.hbm_used}/{sess.memory_cap} used)")
+            sid = sess.fresh_id()
+            sess.staging[sid] = (total, bytearray(total))
+            return {"ok": True, "staging": sid}
+
+        if op == "put_chunk":
+            total, raw = sess.staging[int(req["staging"])]
+            blob = state["blob"] or b""
+            off = int(req["offset"])
+            if off < 0 or off + len(blob) > total:
+                raise ValueError(
+                    f"chunk [{off}, {off + len(blob)}) outside staged {total}")
+            raw[off:off + len(blob)] = blob
+            return {"ok": True}
+
+        if op == "put_commit":
+            total, raw = sess.staging.pop(int(req["staging"]))
+            return self._put_array(sess, load_array(bytes(raw)))
+
+        if op == "put_abort":
+            sess.staging.pop(int(req["staging"]), None)
+            return {"ok": True}
 
         if op == "get":
-            buf = sess.buffers[int(req["handle"])]
+            handle = int(req["handle"])
+            buf = sess.buffers[handle]
+            if "offset" in req:
+                # Sliced fetch: serialize once, cache the stream, serve byte
+                # ranges. The cache is evicted when the final byte is served
+                # (or the handle is freed), so at most one host copy lives
+                # per session regardless of how the client paces its reads.
+                if sess.fetch_cache is None or sess.fetch_cache[0] != handle:
+                    sess.fetch_cache = (handle, dump_array(buf))
+                blob = sess.fetch_cache[1]
+                off, length = int(req["offset"]), int(req["length"])
+                if off < 0 or length <= 0:
+                    raise ValueError(f"bad slice [{off}, +{length})")
+                if off + length >= len(blob):
+                    sess.fetch_cache = None
+                state["reply_blob"] = blob[off:off + length]
+                return {"ok": True, "total": len(blob)}
             if int(buf.nbytes) > protocol.MAX_FRAME - 4096:
                 # An over-frame reply would raise in the server's *send*
                 # path, tearing down the connection — and with it the whole
@@ -320,7 +362,7 @@ class ChipProxy:
                 # error reply and keeps its state.
                 raise ValueError(
                     f"buffer too large to transfer ({int(buf.nbytes)} bytes);"
-                    " fetch it in slices")
+                    " fetch it in slices (get with offset/length)")
             state["reply_blob"] = dump_array(buf)
             return {"ok": True}
 
@@ -329,6 +371,8 @@ class ChipProxy:
                 buf = sess.buffers.pop(int(handle), None)
                 if buf is not None:
                     sess.hbm_used -= int(buf.nbytes)
+                if sess.fetch_cache and sess.fetch_cache[0] == int(handle):
+                    sess.fetch_cache = None
             return {"ok": True}
 
         if op == "compile":
@@ -351,6 +395,25 @@ class ChipProxy:
             return {"ok": True}
 
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _put_array(self, sess: _Session, arr) -> dict:
+        # Pre-check with the host-side size so an over-cap upload is
+        # refused before touching the device at all...
+        self._charge(sess, arr.nbytes)
+        sess.hbm_used -= arr.nbytes
+        buf = self._jax.device_put(arr, self.device)
+        try:
+            # ...then account the *device* buffer: device_put
+            # canonicalizes dtypes (e.g. int64→int32 with x64 off), so
+            # charging the host size would leak on every put/free cycle.
+            self._charge(sess, int(buf.nbytes))
+        except HBMError:
+            del buf
+            raise
+        handle = sess.fresh_id()
+        sess.buffers[handle] = buf
+        return {"ok": True, "handle": handle,
+                "shape": list(buf.shape), "dtype": str(buf.dtype)}
 
     def _compile(self, sess: _Session, blob: bytes,
                  ncarry: int | None = None) -> dict:
