@@ -1,0 +1,386 @@
+"""Kubernetes pod-event bridge: the top of the control loop.
+
+The reference compiles its engine *into* kube-scheduler
+(``cmd/kubeshare-scheduler/main.go:26-37``), so pod events arrive through
+informers and decisions leave through the framework's Bind. The TPU-native
+scheduler is a k8s-independent HTTP service (:mod:`.service`); this bridge
+closes the loop around it:
+
+- **watch** the API server for pods whose ``spec.schedulerName`` is ours
+  (a plain chunked JSON-lines HTTP stream — no client library needed),
+- **drive** ``POST /schedule`` / ``DELETE /pods`` on the scheduler service,
+- **write back** the decision: annotations first (so ``fieldRef``-declared
+  env resolves before the container starts), then the ``Binding``
+  subresource — the reference's Reserve-annotate + Bind in-process steps
+  (``pkg/scheduler/pod.go:348-476``, ``scheduler.go:589-614``).
+- **replay**: on (re)start, already-bound pods found in the initial list
+  are fed to ``POST /resync`` — the informer re-queue behavior of
+  ``pod.go:47-78``.
+
+Unlike the reference, no shadow-pod delete/recreate is needed for env
+injection: the share parameters ride as annotations, and the pod template
+exposes them via the downward API
+(``env: valueFrom: fieldRef: metadata.annotations['sharedtpu/...']`` —
+see ``doc/deploy.md``).
+
+Everything is injectable for tests: point ``KubeClient`` at a fake API
+server and ``ServiceClient`` at an in-process scheduler service.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from .. import constants as C
+from ..utils.logger import get_logger
+
+log = get_logger("bridge")
+
+SCHEDULER_NAME = "kubeshare-tpu-scheduler"
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def _sa_path(name: str) -> str | None:
+    path = os.path.join(SA_DIR, name)
+    return path if os.path.exists(path) else None
+
+
+class KubeClient:
+    """Minimal API-server client: list / watch / annotate / bind.
+
+    In-cluster defaults (service-account token + CA + the
+    ``KUBERNETES_SERVICE_HOST`` env) apply when constructor args are
+    omitted; tests pass an explicit plain-HTTP ``base_url``.
+    """
+
+    def __init__(self, base_url: str = "", token: str = "",
+                 ca_file: str = "", timeout: float = 30.0):
+        if not base_url:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "no --kube-api given and KUBERNETES_SERVICE_HOST unset")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        if not token:
+            tok_path = _sa_path("token")
+            token = open(tok_path).read().strip() if tok_path else ""
+        self.token = token
+        self.timeout = timeout
+        self._ctx = None
+        if self.base_url.startswith("https"):
+            ca = ca_file or _sa_path("ca.crt")
+            self._ctx = (ssl.create_default_context(cafile=ca) if ca
+                         else ssl.create_default_context())
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 content_type: str = "application/json",
+                 timeout: float | None = None):
+        req = urllib.request.Request(self.base_url + path, method=method)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            req.add_header("Content-Type", content_type)
+        return urllib.request.urlopen(
+            req, data=data, timeout=timeout or self.timeout,
+            context=self._ctx)
+
+    # -- reads ---------------------------------------------------------------
+
+    def list_pods(self, scheduler_name: str) -> tuple[list[dict], str]:
+        """All pods claiming *scheduler_name* + the list resourceVersion
+        (the watch bookmark). ``spec.schedulerName`` is a supported pod
+        field selector, so the server filters for us."""
+        sel = urllib.parse.quote(f"spec.schedulerName={scheduler_name}")
+        with self._request("GET", f"/api/v1/pods?fieldSelector={sel}") as r:
+            obj = json.load(r)
+        return (obj.get("items") or [],
+                obj.get("metadata", {}).get("resourceVersion", ""))
+
+    def watch_pods(self, scheduler_name: str, resource_version: str):
+        """Yield ``(type, pod)`` watch events; returns when the server
+        closes the stream (caller re-lists and re-watches)."""
+        sel = urllib.parse.quote(f"spec.schedulerName={scheduler_name}")
+        path = (f"/api/v1/pods?watch=1&fieldSelector={sel}"
+                f"&allowWatchBookmarks=true")
+        if resource_version:
+            path += f"&resourceVersion={resource_version}"
+        # A watch is long-lived by design: no read timeout beyond the
+        # server's own (the caller loops on reconnect).
+        with self._request("GET", path, timeout=3600.0) as resp:
+            for line in resp:
+                if not line.strip():
+                    continue
+                evt = json.loads(line)
+                yield evt.get("type", ""), evt.get("object", {})
+
+    # -- writes --------------------------------------------------------------
+
+    def annotate(self, namespace: str, name: str,
+                 annotations: dict[str, str]) -> None:
+        body = {"metadata": {"annotations": annotations}}
+        self._request(
+            "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}",
+            body=body, content_type="application/merge-patch+json").close()
+
+    def bind(self, namespace: str, name: str, node: str,
+             uid: str = "") -> None:
+        body = {
+            "apiVersion": "v1", "kind": "Binding",
+            "metadata": {"name": name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+        }
+        if uid:
+            body["metadata"]["uid"] = uid
+        self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            body=body).close()
+
+
+class ServiceClient:
+    """HTTP client for :class:`.service.SchedulerService`."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str,
+              body: dict | None = None) -> tuple[int, dict]:
+        req = urllib.request.Request(self.base_url + path, method=method)
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, data=data,
+                                        timeout=self.timeout) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.load(e)
+            except Exception:
+                return e.code, {"error": str(e)}
+
+    def schedule(self, namespace: str, name: str, labels: dict,
+                 uid: str = "") -> tuple[int, dict]:
+        return self._call("POST", "/schedule",
+                          {"namespace": namespace, "name": name,
+                           "labels": labels, "uid": uid})
+
+    def resync(self, namespace: str, name: str, labels: dict,
+               annotations: dict, node: str, uid: str = "") -> tuple[int, dict]:
+        return self._call("POST", "/resync",
+                          {"namespace": namespace, "name": name,
+                           "labels": labels, "annotations": annotations,
+                           "node": node, "uid": uid})
+
+    def delete(self, namespace: str, name: str) -> tuple[int, dict]:
+        return self._call("DELETE", f"/pods/{namespace}/{name}")
+
+    def status(self, namespace: str, name: str) -> tuple[int, dict]:
+        return self._call("GET", f"/pods/{namespace}/{name}")
+
+
+def pod_fields(pod: dict) -> dict:
+    """The slice of a Pod object the bridge acts on."""
+    meta = pod.get("metadata", {})
+    spec = pod.get("spec", {})
+    return {
+        "namespace": meta.get("namespace", "default"),
+        "name": meta.get("name", ""),
+        "uid": meta.get("uid", ""),
+        "labels": meta.get("labels") or {},
+        "annotations": meta.get("annotations") or {},
+        "node": spec.get("nodeName", ""),
+        "scheduler": spec.get("schedulerName", ""),
+        "deleting": bool(meta.get("deletionTimestamp")),
+    }
+
+
+class PodEventBridge:
+    """Convert pod events into scheduler-service calls and write back."""
+
+    def __init__(self, service: ServiceClient, kube: KubeClient,
+                 scheduler_name: str = SCHEDULER_NAME,
+                 reconnect_s: float = 2.0, poll_s: float = 1.0):
+        self.service = service
+        self.kube = kube
+        self.scheduler_name = scheduler_name
+        self.reconnect_s = reconnect_s
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # pods we have already bound (or resynced) this incarnation, so a
+        # MODIFIED echo of our own bind/annotate write is not re-scheduled
+        self._settled: set[str] = set()
+        # pods whose /schedule returned 202 (parked at the gang barrier /
+        # unschedulable-retrying): the dispatcher's own loop will bind them
+        # later with no pod event to wake us, so a poller watches their
+        # status and performs the deferred write-back
+        self._awaiting: dict[str, tuple[str, str, str]] = {}
+
+    # -- event handling ------------------------------------------------------
+
+    def handle(self, etype: str, pod: dict) -> None:
+        f = pod_fields(pod)
+        if f["scheduler"] != self.scheduler_name or not f["name"]:
+            return
+        key = f"{f['namespace']}/{f['name']}"
+        if etype == "DELETED" or f["deleting"]:
+            self._settled.discard(key)
+            self._awaiting.pop(key, None)
+            self.service.delete(f["namespace"], f["name"])
+            log.info("pod %s deleted → released", key)
+            return
+        if etype not in ("ADDED", "MODIFIED", ""):
+            return  # BOOKMARK / ERROR: nothing to act on
+        if f["node"]:
+            # Already bound. Ours (has our cell annotation) and not yet
+            # replayed this incarnation → resync; otherwise ignore.
+            if key not in self._settled and C.POD_CELL_ID in f["annotations"]:
+                self.service.resync(f["namespace"], f["name"], f["labels"],
+                                    f["annotations"], f["node"], f["uid"])
+                self._settled.add(key)
+                log.info("pod %s already bound to %s → resynced",
+                         key, f["node"])
+            return
+        if key in self._settled:
+            return
+        code, result = self.service.schedule(
+            f["namespace"], f["name"], f["labels"], f["uid"])
+        if code == 200:
+            self._write_back(key, f["namespace"], f["name"], f["uid"],
+                             result)
+        elif code == 202:
+            self._awaiting[key] = (f["namespace"], f["name"], f["uid"])
+            log.info("pod %s pending: %s", key, result.get("reason", ""))
+        else:
+            log.warning("pod %s rejected (%d): %s", key, code,
+                        result.get("error") or result.get("reason"))
+
+    def _write_back(self, key: str, namespace: str, name: str, uid: str,
+                    result: dict) -> None:
+        # Annotate BEFORE bind: fieldRef env resolves when the kubelet
+        # starts the container, which the bind triggers.
+        self.kube.annotate(namespace, name, result.get("annotations", {}))
+        self.kube.bind(namespace, name, result["node"], uid)
+        self._settled.add(key)
+        self._awaiting.pop(key, None)
+        log.info("pod %s bound to %s", key, result["node"])
+
+    def poll_pending(self) -> None:
+        """Write back pods the dispatcher bound after their 202: a gang
+        member released by Permit (or an unschedulable retry that fit once
+        capacity freed) generates no pod event, so polling is the only
+        wake-up."""
+        for key, (ns, name, uid) in list(self._awaiting.items()):
+            try:
+                code, st = self.service.status(ns, name)
+            except Exception as e:
+                log.warning("status poll of %s failed: %s", key, e)
+                continue
+            state = st.get("status") if code == 200 else None
+            if state == "bound":
+                self._write_back(key, ns, name, uid, st)
+            elif state not in ("parked", "pending"):
+                # terminal (rejected / deleted / unknown): stop polling —
+                # a future MODIFIED event re-enters via handle()
+                self._awaiting.pop(key, None)
+                log.info("pod %s left the queue: %s", key, state)
+
+    def sync_once(self) -> str:
+        """List current pods, feed each through :meth:`handle`; returns the
+        resourceVersion to watch from."""
+        items, version = self.kube.list_pods(self.scheduler_name)
+        for pod in items:
+            try:
+                self.handle("ADDED", pod)
+            except Exception as e:
+                log.warning("sync of %s failed: %s",
+                            pod.get("metadata", {}).get("name"), e)
+        return version
+
+    # -- loop ----------------------------------------------------------------
+
+    def run(self) -> None:
+        """List+watch until :meth:`stop`; reconnects with a fixed backoff
+        (a dropped watch is routine — the API server times streams out)."""
+        while not self._stop.is_set():
+            try:
+                version = self.sync_once()
+                for etype, obj in self.kube.watch_pods(
+                        self.scheduler_name, version):
+                    if self._stop.is_set():
+                        return
+                    try:
+                        self.handle(etype, obj)
+                    except Exception as e:
+                        log.warning("event %s failed: %s", etype, e)
+            except Exception as e:
+                log.warning("watch dropped: %s", e)
+            self._stop.wait(self.reconnect_s)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.poll_pending()
+
+    def start(self) -> "PodEventBridge":
+        self._threads = [
+            threading.Thread(target=self.run, daemon=True,
+                             name="pod-event-bridge"),
+            threading.Thread(target=self._poll_loop, daemon=True,
+                             name="pod-event-bridge-poll"),
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+
+def main(argv=None) -> None:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu.scheduler.bridge")
+    parser.add_argument("--service", required=True,
+                        help="scheduler service base URL, e.g. "
+                             "http://kubeshare-tpu-scheduler:9006")
+    parser.add_argument("--kube-api", default="",
+                        help="API server base URL (default: in-cluster env)")
+    parser.add_argument("--scheduler-name", default=SCHEDULER_NAME)
+    parser.add_argument("--once", action="store_true",
+                        help="process the current pod list and exit "
+                             "(no watch) — for debugging")
+    args = parser.parse_args(argv)
+
+    bridge = PodEventBridge(ServiceClient(args.service),
+                            KubeClient(args.kube_api),
+                            scheduler_name=args.scheduler_name)
+    if args.once:
+        bridge.sync_once()
+        return
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    bridge.start()
+    print("READY", flush=True)
+    stop.wait()
+    bridge.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,264 @@
+"""Pod-event bridge: fake kube-apiserver ⇄ real scheduler service.
+
+The reference gets pod events through kube-scheduler's informers; here the
+bridge consumes the watch API directly, so the test stands up a minimal
+API-server (list, watch stream, merge-patch, binding subresource) and
+asserts the full loop: event → /schedule → annotate → bind → engine state.
+"""
+
+import json
+import time
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeshare_tpu import constants as C
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.bridge import (KubeClient, PodEventBridge,
+                                            ServiceClient, pod_fields)
+from kubeshare_tpu.scheduler.service import SchedulerService
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+SCHED = "kubeshare-tpu-scheduler"
+
+
+def make_pod(name, labels=None, node="", annotations=None, uid=""):
+    return {
+        "metadata": {"namespace": "default", "name": name,
+                     "uid": uid or f"uid-{name}",
+                     "labels": labels or {},
+                     "annotations": annotations or {}},
+        "spec": {"schedulerName": SCHED, "nodeName": node},
+    }
+
+
+class FakeKubeAPI:
+    """Just enough API server for the bridge: list, one-shot watch stream,
+    merge-patch annotations, Binding subresource."""
+
+    def __init__(self):
+        self.pods: dict[str, dict] = {}        # "ns/name" -> pod object
+        self.events: list[tuple[str, dict]] = []  # queued watch events
+        self.patches: list[tuple[str, dict]] = []
+        self.binds: list[tuple[str, str]] = []
+        self.order: list[str] = []             # interleaving of writes
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urllib.parse.urlparse(self.path)
+                q = urllib.parse.parse_qs(url.query)
+                if url.path != "/api/v1/pods":
+                    return self._reply(404, {})
+                if q.get("watch"):
+                    self.send_response(200)
+                    self.end_headers()
+                    for etype, obj in api.events:
+                        line = json.dumps(
+                            {"type": etype, "object": obj}) + "\n"
+                        self.wfile.write(line.encode())
+                        self.wfile.flush()
+                    api.events = []
+                    return  # close: bridge re-lists on its own
+                self._reply(200, {"items": list(api.pods.values()),
+                                  "metadata": {"resourceVersion": "1"}})
+
+            def do_PATCH(self):
+                parts = self.path.strip("/").split("/")  # api v1 ns X pods Y
+                key = f"{parts[3]}/{parts[5]}"
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length))
+                ann = body.get("metadata", {}).get("annotations", {})
+                api.pods[key]["metadata"].setdefault(
+                    "annotations", {}).update(ann)
+                api.patches.append((key, ann))
+                api.order.append(f"patch:{key}")
+                self._reply(200, api.pods[key])
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                assert parts[-1] == "binding"
+                key = f"{parts[3]}/{parts[5]}"
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length))
+                node = body["target"]["name"]
+                api.pods[key]["spec"]["nodeName"] = node
+                api.binds.append((key, node))
+                api.order.append(f"bind:{key}")
+                self._reply(201, {"kind": "Status", "status": "Success"})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server.daemon_threads = True
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return "http://127.0.0.1:%d" % self.server.server_address[1]
+
+    def add_pod(self, pod):
+        key = f"{pod['metadata']['namespace']}/{pod['metadata']['name']}"
+        self.pods[key] = pod
+        return key
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def make_service(reg=None):
+    eng = SchedulerEngine()
+    reg = reg or TelemetryRegistry()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=2, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        reg.put_capacity(host, [c.to_labels() for c in chips])
+    svc = SchedulerService(eng, reg, replay=False)
+    svc.serve()
+    return eng, svc
+
+
+def make_bridge(api, svc):
+    return PodEventBridge(
+        ServiceClient(f"http://127.0.0.1:{svc.port}"),
+        KubeClient(api.url), scheduler_name=SCHED)
+
+
+def test_pod_fields_extraction():
+    f = pod_fields(make_pod("p", labels={C.POD_TPU_REQUEST: "0.5",
+                                         C.POD_TPU_LIMIT: "1.0"},
+                            node="n0"))
+    assert f["name"] == "p" and f["node"] == "n0"
+    assert f["labels"] == {C.POD_TPU_REQUEST: "0.5",
+                           C.POD_TPU_LIMIT: "1.0"}
+    assert not f["deleting"]
+
+
+def test_bridge_schedules_annotates_then_binds():
+    api = FakeKubeAPI()
+    eng, svc = make_service()
+    try:
+        key = api.add_pod(make_pod("train", labels={
+            C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"}))
+        bridge = make_bridge(api, svc)
+        bridge.sync_once()
+        assert api.binds and api.binds[0][0] == key
+        node = api.binds[0][1]
+        assert node in eng.nodes
+        ann = api.pods[key]["metadata"]["annotations"]
+        assert C.POD_TPU_CHIP_ID in ann and C.POD_CELL_ID in ann
+        # annotations must land before the bind (fieldRef env contract)
+        assert api.order.index(f"patch:{key}") < api.order.index(f"bind:{key}")
+        assert f"default/train" in eng.pod_status
+    finally:
+        svc.close()
+        api.close()
+
+
+def test_bridge_replays_bound_and_ignores_own_echo():
+    api = FakeKubeAPI()
+    eng, svc = make_service()
+    try:
+        # First incarnation binds the pod.
+        key = api.add_pod(make_pod("p1", labels={C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"}))
+        bridge = make_bridge(api, svc)
+        bridge.sync_once()
+        booked = dict(eng.pod_status)
+        assert key in booked
+        # MODIFIED echo of our own writes: no double-schedule.
+        bridge.handle("MODIFIED", api.pods[key])
+        assert eng.pod_status[key].chip_ids == booked[key].chip_ids
+
+        # Service restarts (fresh engine): a NEW bridge must resync the
+        # already-bound pod into it from the pod object alone.
+        svc.close()
+        eng2, svc2 = make_service()
+        bridge2 = make_bridge(api, svc2)
+        bridge2.sync_once()
+        assert not api.events  # nothing re-bound
+        assert key in eng2.pod_status
+        assert eng2.pod_status[key].node_name == booked[key].node_name
+        svc2.close()
+    finally:
+        api.close()
+
+
+def test_bridge_delete_releases_and_invalid_rejected():
+    api = FakeKubeAPI()
+    eng, svc = make_service()
+    try:
+        bridge = make_bridge(api, svc)
+        key = api.add_pod(make_pod("p", labels={C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"}))
+        bridge.sync_once()
+        assert key in eng.pod_status
+        bridge.handle("DELETED", api.pods[key])
+        assert key not in eng.pod_status
+
+        # Invalid labels: rejected upstream, nothing annotated or bound.
+        bad = api.add_pod(make_pod("bad", labels={C.POD_TPU_REQUEST: "2.5", C.POD_TPU_LIMIT: "1.0"}))
+        binds_before = list(api.binds)
+        bridge.handle("ADDED", api.pods[bad])
+        assert api.binds == binds_before
+        assert bad not in eng.pod_status
+    finally:
+        svc.close()
+        api.close()
+
+
+def test_bridge_watch_stream_end_to_end():
+    api = FakeKubeAPI()
+    eng, svc = make_service()
+    try:
+        bridge = make_bridge(api, svc)
+        pod = make_pod("late", labels={C.POD_TPU_REQUEST: "1", C.POD_TPU_LIMIT: "1"})
+        api.add_pod(pod)
+        api.events.append(("ADDED", pod))
+        version = "1"
+        for etype, obj in bridge.kube.watch_pods(SCHED, version):
+            bridge.handle(etype, obj)
+        assert "default/late" in eng.pod_status
+        assert api.binds
+    finally:
+        svc.close()
+        api.close()
+
+
+def test_bridge_writes_back_gang_member_bound_after_202():
+    """A gang member parked at the Permit barrier generates no pod event
+    when the dispatcher later binds it — the poller must write it back."""
+    api = FakeKubeAPI()
+    eng, svc = make_service()
+    try:
+        bridge = make_bridge(api, svc)
+        gang = {C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0",
+                C.POD_GROUP_NAME: "g", C.POD_GROUP_HEADCOUNT: "2",
+                C.POD_GROUP_THRESHOLD: "1"}
+        a = api.add_pod(make_pod("ga", labels=dict(gang)))
+        bridge.handle("ADDED", api.pods[a])
+        assert not api.binds            # parked: below threshold
+        b = api.add_pod(make_pod("gb", labels=dict(gang)))
+        bridge.handle("ADDED", api.pods[b])
+        # Threshold reached: the dispatcher releases the gang. Whichever
+        # member got its 200 synchronously was written back already; the
+        # parked one needs the poll.
+        deadline = time.time() + 10
+        while len(api.binds) < 2 and time.time() < deadline:
+            bridge.poll_pending()
+            time.sleep(0.05)
+        assert {k for k, _ in api.binds} == {a, b}
+        assert a in eng.pod_status and b in eng.pod_status
+    finally:
+        svc.close()
+        api.close()
