@@ -1,0 +1,17 @@
+#!/bin/sh
+# TPU node preparation (≙ scripts-by-sonjoyp/KubeShare-GPU-Node-Preparation.sh):
+# create the hostPath state tree the node daemon and workloads share, with
+# permissions that let non-root workload containers read client files.
+set -eu
+
+BASE=${KUBESHARE_TPU_BASE:-/var/lib/kubeshare-tpu}
+LOGS=${KUBESHARE_TPU_LOGS:-/var/log/kubeshare-tpu}
+
+for d in "$BASE/library" "$BASE/scheduler/config" "$BASE/scheduler/podmanagerport" "$LOGS"; do
+    mkdir -p "$d"
+done
+chmod 755 "$BASE" "$BASE/library" "$BASE/scheduler"
+chmod 755 "$BASE/scheduler/config" "$BASE/scheduler/podmanagerport"
+chmod 1777 "$LOGS"
+
+echo "kubeshare-tpu node state ready under $BASE (logs: $LOGS)"
