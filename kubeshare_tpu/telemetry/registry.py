@@ -23,15 +23,24 @@ HTTP API (JSON bodies):
 - ``GET  /pods[?node=X]``      {key: record}
 - ``DELETE /pods/<ns>/<name>``
 - ``GET  /metrics``            Prometheus exposition (capacity+requirement)
+
+**Durability**: pass ``journal=<path>`` and every mutation is appended to
+a JSONL journal (compacted to a snapshot every ``compact_every`` writes),
+replayed on construction — a registry restart no longer loses bindings
+and capacity. The reference survives restarts via the k8s API + pod
+annotations; the dispatcher's startup ``replay_bound`` plays the same
+role here and needs the registry to remember (``pod.go:47-78``).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 
 from ..utils.logger import get_logger
 
@@ -51,23 +60,128 @@ def render_metric(name: str, labels: dict, value: float) -> str:
 class TelemetryRegistry:
     """In-memory cluster state with an HTTP surface."""
 
-    def __init__(self):
+    def __init__(self, journal: str | os.PathLike | None = None,
+                 compact_every: int = 1000):
         self._lock = threading.Lock()
         self._capacity: dict[str, dict] = {}
         self._pods: dict[str, dict] = {}
         self._server: ThreadingHTTPServer | None = None
+        self._journal_path = Path(journal) if journal else None
+        self._journal = None
+        self._compact_every = compact_every
+        self._writes = 0
+        if self._journal_path is not None:
+            self._replay()
+            self._journal = open(self._journal_path, "a", encoding="utf-8")
+            # a crash mid-append leaves a torn line with no newline; start
+            # the next record on a fresh line or the two would glue into
+            # one unparseable record
+            if self._journal.tell() > 0:
+                with open(self._journal_path, "rb") as fh:
+                    fh.seek(-1, os.SEEK_END)
+                    if fh.read(1) != b"\n":
+                        self._journal.write("\n")
+                        self._journal.flush()
+
+    # -- durability --------------------------------------------------------
+
+    def _replay(self) -> None:
+        if not self._journal_path.exists():
+            return
+        applied = bad = 0
+        with open(self._journal_path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    self._apply(rec)
+                    applied += 1
+                except (ValueError, KeyError):
+                    # a torn final line from a crash mid-append is expected;
+                    # anything else is still better skipped than fatal
+                    bad += 1
+        if applied or bad:
+            log.info("journal replay: %d records (%d skipped), "
+                     "%d nodes, %d pods", applied, bad,
+                     len(self._capacity), len(self._pods))
+
+    def _apply(self, rec: dict) -> None:
+        op = rec["op"]
+        if op == "put_capacity":
+            self._capacity[rec["node"]] = {"chips": rec["chips"],
+                                           "healthy": rec["healthy"],
+                                           "ts": rec["ts"]}
+        elif op == "drop_capacity":
+            self._capacity.pop(rec["node"], None)
+        elif op == "put_pod":
+            self._pods[rec["key"]] = rec["record"]
+        elif op == "drop_pod":
+            self._pods.pop(rec["key"], None)
+        else:
+            raise KeyError(op)
+
+    def _log(self, rec: dict) -> None:
+        """Append one mutation (caller holds the lock). Every
+        ``compact_every`` writes the journal is rewritten as a snapshot —
+        an append-only file would otherwise grow with every heartbeat
+        re-put of unchanged capacity."""
+        if self._journal is None:
+            return
+        self._journal.write(json.dumps(rec) + "\n")
+        self._journal.flush()
+        # fsync every record: an acknowledged binding that only reached the
+        # page cache would vanish on power loss, and the dispatcher's
+        # replay would then double-book the chip. Mutations are low-rate
+        # (capacity heartbeats + bind/unbind), so the sync cost is noise.
+        os.fsync(self._journal.fileno())
+        self._writes += 1
+        if self._writes >= self._compact_every:
+            self._compact()
+
+    def _compact(self) -> None:
+        tmp = self._journal_path.with_suffix(".tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for node, entry in self._capacity.items():
+                fh.write(json.dumps({"op": "put_capacity", "node": node,
+                                     **entry}) + "\n")
+            for key, record in self._pods.items():
+                fh.write(json.dumps({"op": "put_pod", "key": key,
+                                     "record": record}) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        old = self._journal
+        self._journal = None  # _log becomes a no-op if the swap fails
+        try:
+            old.close()
+            os.replace(tmp, self._journal_path)  # atomic: old or new state
+        finally:
+            # Reopen unconditionally: on a failed replace we keep appending
+            # to the pre-compaction journal (state is still consistent);
+            # a reopen failure leaves journaling disabled but the registry
+            # serving — better than erroring every write with memory and
+            # disk silently diverged.
+            try:
+                self._journal = open(self._journal_path, "a",
+                                     encoding="utf-8")
+            except OSError as e:
+                log.error("journal reopen failed, durability disabled: %s", e)
+            self._writes = 0
 
     # -- state (thread-safe, also usable in-process) -----------------------
 
     def put_capacity(self, node: str, chips: list[dict],
                      healthy: bool = True) -> None:
         with self._lock:
-            self._capacity[node] = {"chips": chips, "healthy": healthy,
-                                    "ts": time.time()}
+            entry = {"chips": chips, "healthy": healthy, "ts": time.time()}
+            self._capacity[node] = entry
+            self._log({"op": "put_capacity", "node": node, **entry})
 
     def drop_capacity(self, node: str) -> None:
         with self._lock:
             self._capacity.pop(node, None)
+            self._log({"op": "drop_capacity", "node": node})
 
     def capacity(self) -> dict[str, dict]:
         with self._lock:
@@ -75,11 +189,14 @@ class TelemetryRegistry:
 
     def put_pod(self, key: str, record: dict) -> None:
         with self._lock:
-            self._pods[key] = dict(record, ts=time.time())
+            rec = dict(record, ts=time.time())
+            self._pods[key] = rec
+            self._log({"op": "put_pod", "key": key, "record": rec})
 
     def drop_pod(self, key: str) -> None:
         with self._lock:
             self._pods.pop(key, None)
+            self._log({"op": "drop_pod", "key": key})
 
     def pods(self, node: str | None = None) -> dict[str, dict]:
         with self._lock:
@@ -186,6 +303,10 @@ class TelemetryRegistry:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
 
 
 class RegistryClient:
@@ -230,3 +351,29 @@ class RegistryClient:
         req = urllib.request.Request(self._base + "/metrics")
         with urllib.request.urlopen(req, timeout=self._timeout) as resp:
             return resp.read().decode()
+
+
+def main(argv=None) -> None:
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu.telemetry.registry")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9006)
+    parser.add_argument("--journal", default="",
+                        help="JSONL journal path; state survives restarts "
+                             "when set (mount a PVC/hostPath there)")
+    args = parser.parse_args(argv)
+
+    registry = TelemetryRegistry(journal=args.journal or None)
+    registry.serve(args.host, args.port)
+    print("READY", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    registry.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -99,3 +99,60 @@ def test_collector_failure_reports_unhealthy(client):
     collector = CapacityCollector(client, node="bad-node", backend="bogus")
     assert not collector.collect_once()
     assert client.capacity()["bad-node"]["healthy"] is False
+
+
+# -- journal durability ------------------------------------------------------
+
+
+def test_journal_survives_restart(tmp_path):
+    j = tmp_path / "registry.jsonl"
+    r1 = TelemetryRegistry(journal=j)
+    r1.put_capacity("n0", [{"chip_id": "c0"}])
+    r1.put_capacity("n1", [{"chip_id": "c1"}], healthy=False)
+    r1.put_pod("ns/p", {"node": "n0", "request": 0.5})
+    r1.put_capacity("n1", [{"chip_id": "c1b"}])   # overwrite
+    r1.drop_pod("ns/gone")                        # no-op drop journals fine
+    r1.close()
+
+    r2 = TelemetryRegistry(journal=j)
+    cap = r2.capacity()
+    assert set(cap) == {"n0", "n1"}
+    assert cap["n1"]["chips"] == [{"chip_id": "c1b"}]
+    assert cap["n1"]["healthy"] is True
+    pods = r2.pods()
+    assert pods["ns/p"]["node"] == "n0" and pods["ns/p"]["request"] == 0.5
+    r2.close()
+
+
+def test_journal_compaction_bounds_size_and_preserves_state(tmp_path):
+    j = tmp_path / "registry.jsonl"
+    r = TelemetryRegistry(journal=j, compact_every=10)
+    for i in range(100):                     # heartbeat re-puts, 10x compaction
+        r.put_capacity("n0", [{"chip_id": f"c{i}"}])
+    r.put_pod("ns/p", {"node": "n0"})
+    r.close()
+    lines = [l for l in j.read_text().splitlines() if l.strip()]
+    assert len(lines) <= 12                  # snapshot + tail, not 101 appends
+    r2 = TelemetryRegistry(journal=j)
+    assert r2.capacity()["n0"]["chips"] == [{"chip_id": "c99"}]
+    assert "ns/p" in r2.pods()
+    r2.close()
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    j = tmp_path / "registry.jsonl"
+    r = TelemetryRegistry(journal=j)
+    r.put_capacity("n0", [{"chip_id": "c0"}])
+    r.put_pod("ns/p", {"node": "n0"})
+    r.close()
+    with open(j, "a") as fh:                 # crash mid-append
+        fh.write('{"op": "put_pod", "key": "ns/q", "rec')
+    r2 = TelemetryRegistry(journal=j)
+    assert "n0" in r2.capacity() and "ns/p" in r2.pods()
+    assert "ns/q" not in r2.pods()
+    # and the reopened journal still accepts writes after the torn line
+    r2.put_pod("ns/r", {"node": "n0"})
+    r2.close()
+    r3 = TelemetryRegistry(journal=j)
+    assert "ns/r" in r3.pods()
+    r3.close()
