@@ -226,7 +226,43 @@ def main(argv=None) -> int:
     parser.add_argument("--probe-timeout", type=float, default=180.0,
                         help="seconds to wait for backend init in the probe "
                              "subprocess before declaring the chip wedged")
+    parser.add_argument("--watchdog", type=float, default=-1.0,
+                        help="overall wall-clock budget; <0 = auto, "
+                             "0 = disabled (run in-process)")
     args = parser.parse_args(argv)
+
+    # The axon tunnel can wedge MID-RUN (not just at init), hanging the
+    # process inside C where no Python timeout reaches — the driver would
+    # record rc=124 and no JSON. Run the real bench in a child with a
+    # wall-clock budget so a wedge still yields a diagnostic line.
+    if args.watchdog != 0.0:
+        budget = args.watchdog
+        if budget < 0:
+            budget = (args.probe_timeout + args.exclusive_seconds
+                      + args.colocated_seconds + 300.0)  # slack: XLA compiles
+        raw = list(argv if argv is not None else sys.argv[1:])
+        child_args, skip = [], False
+        for a in raw:
+            if skip:
+                skip = False
+            elif a == "--watchdog":
+                skip = True            # drop the separate value token too
+            elif not a.startswith("--watchdog="):
+                child_args.append(a)
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, *child_args, "--watchdog", "0"],
+                timeout=budget, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"metric": "colocated_2x0.5_aggregate_ratio",
+                              "value": 0.0, "unit": "fraction",
+                              "vs_baseline": 0.0,
+                              "error": f"bench hung > {budget:.0f}s "
+                                       "(tunnel wedged mid-run?)"}))
+            return 1
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        return proc.returncode
 
     err = _probe_backend(args.probe_timeout)
     if err is not None:

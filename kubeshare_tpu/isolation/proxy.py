@@ -54,7 +54,12 @@ class _Executable:
     out_meta: list[tuple[list[int], str]]  # (shape, dtype) per output
     ncarry: int | None = None     # loop programs: first ncarry args/outs thread
     fn: object = None             # AOT-compiled single call (lazy)
-    chunk: object = None          # AOT-compiled dynamic-n loop (lazy)
+    # AOT-compiled fused loops, one per STATIC power-of-two trip count
+    # (lazy; at most log2(max burst) entries). Static because a dynamic
+    # trip count costs ~60 ms fixed + ~0.1 ms/iteration on the TPU
+    # transport backend — measured 79 ms/call where the static-bound
+    # program runs the identical 100 steps in 0.24 ms.
+    chunks: dict = field(default_factory=dict)
     # Burst cost model: burst_ms ≈ step_ms + (n-1) * loop_step_ms. The two
     # are tracked separately because XLA may run a while-loop body at a
     # different speed than straight-line code (dramatically so on CPU,
@@ -92,6 +97,11 @@ class _Session:
     def fresh_id(self) -> int:
         self.next_id += 1
         return self.next_id
+
+
+def _bucket(n: int) -> int:
+    """Largest power of two ≤ n — the static trip counts we compile for."""
+    return 1 << (max(1, int(n)).bit_length() - 1)
 
 
 class HBMError(RuntimeError):
@@ -456,21 +466,29 @@ class ChipProxy:
                       .lower(*exe.in_specs).compile())
         return exe.fn
 
-    def _chunk_fn(self, exe: _Executable):
-        """N executions fused into ONE XLA program via ``lax.fori_loop``
-        with a *dynamic* trip count — the TPU-native answer to per-step
+    def _chunk_fn(self, exe: _Executable, n: int):
+        """``n`` executions fused into ONE XLA program via ``lax.fori_loop``
+        with a *static* trip count — the TPU-native answer to per-step
         dispatch overhead. The first ``ncarry`` outputs feed back into the
         first ``ncarry`` args each iteration (train-step carry); the rest
         are loop-invariant. One dispatch, one token-gated burst, buffers
-        stay device-resident throughout; one compile serves every N.
+        stay device-resident throughout.
+
+        The trip count is baked in (``n`` must be a bucket from
+        :func:`_bucket`): a dynamic-n program measures ~60 ms fixed +
+        ~0.1 ms/iter on the axon TPU transport, 260x the static-bound
+        program for a 100-step mnist burst. Lazy-compiled per bucket, at
+        most log2(burst cap) compiles per program — and the trace cost is
+        n-independent (the loop is not unrolled).
         """
-        if exe.chunk is None:
+        fn = exe.chunks.get(n)
+        if fn is None:
             from ..attach import real_jit
 
             jax = self._jax
             call, ncarry = exe.call, exe.ncarry
 
-            def chunk(n, *args):
+            def chunk(*args):
                 carry, consts = args[:ncarry], args[ncarry:]
                 outs = call(*carry, *consts)
 
@@ -483,14 +501,13 @@ class ChipProxy:
                 final_carry, aux = jax.lax.fori_loop(0, n - 1, body, init)
                 return list(final_carry + aux)
 
-            nspec = jax.ShapeDtypeStruct((), np.int32)
             # The protocol always donates the carry (RemoteLoop frees those
             # handles on success), so give XLA the aliasing: without it a
             # training client needs 2x its state in HBM at every dispatch.
-            exe.chunk = (real_jit()(chunk,
-                                    donate_argnums=tuple(range(1, ncarry + 1)))
-                         .lower(nspec, *exe.in_specs).compile())
-        return exe.chunk
+            fn = (real_jit()(chunk, donate_argnums=tuple(range(ncarry)))
+                  .lower(*exe.in_specs).compile())
+            exe.chunks[n] = fn
+        return fn
 
     def _cap_repeat(self, exe: _Executable, repeat: int) -> int:
         """Clamp a client-requested burst length. The fused loop is one
@@ -538,12 +555,14 @@ class ChipProxy:
             raise ValueError("repeat requires a loop program (compile with "
                              "ncarry / ProxyClient.compile_loop)")
         if exe.ncarry is not None:
-            # All loop-program dispatches ride the chunk executable (its
+            # All loop-program dispatches ride a chunk executable (its
             # fori_loop is a no-op at n=1) — a 1-step tail must not pay a
-            # second full XLA compile via the single path.
-            repeat = self._cap_repeat(exe, repeat)
-            fn = self._chunk_fn(exe)
-            args = [np.int32(repeat), *args]
+            # second full XLA compile via the single path. The quota cap is
+            # then rounded DOWN to a power of two so the static-trip-count
+            # programs stay few (the client learns the clamp via
+            # reply["repeat"] and simply asks again for the remainder).
+            repeat = _bucket(self._cap_repeat(exe, repeat))
+            fn = self._chunk_fn(exe, repeat)
         else:
             fn = self._single_fn(exe)
         # Cap check up front — allocation must not happen over-cap even

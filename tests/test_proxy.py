@@ -270,8 +270,15 @@ def test_compile_loop_fuses_steps(proxy):
         c.free(l)
         used_before = c.usage()["exec_count"]
         w, l = loop(60, w, batch)
-        assert loop.last_n == 60  # estimates seeded → full fused burst
+        # Estimates seeded → a full fused burst, rounded DOWN to the
+        # static-trip-count bucket (largest power of two ≤ 60).
+        assert loop.last_n == 32
         assert c.usage()["exec_count"] == used_before + 1  # ONE dispatch
+        steps = 1 + 2 + 32
+        while steps < 63:  # client asks again for the remainder
+            c.free(l)
+            w, l = loop(63 - steps, w, batch)
+            steps += loop.last_n
         assert float(c.get(l)) < 1e-3
         np.testing.assert_allclose(c.get(w), w_true, atol=1e-2)
         # old carry was donated: only w, l, xs, ys alive
