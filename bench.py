@@ -61,8 +61,16 @@ def _probe_backend(timeout_s: float) -> str | None:
     return None
 
 
-def _exclusive_steps_per_sec(duration: float) -> float:
-    """Isolated baseline: timed steps directly on the default device."""
+def _exclusive_steps_per_sec(duration: float,
+                             fused_chunk: int = 0) -> float:
+    """Isolated baseline: timed steps directly on the default device.
+
+    ``fused_chunk=0`` is the naive per-step loop a user writes;
+    ``fused_chunk=N`` fuses N steps per dispatch exactly like the proxy's
+    hot path — the STRONGER baseline the co-located ratio is judged
+    against (judging only the naive loop would let the framework's own
+    dispatch amortization inflate the ratio past what sharing earns).
+    """
     import jax
     import optax
 
@@ -77,17 +85,30 @@ def _exclusive_steps_per_sec(duration: float) -> float:
     step = make_train_step(mnist.loss_fn, optimizer)
     batch = mnist.batch_fn(bkey)
 
+    if fused_chunk:
+        def chunk(params, opt_state, batch):
+            def body(_, c):
+                p, o, _l = c
+                return step(p, o, batch)
+            return jax.lax.fori_loop(0, fused_chunk, body,
+                                     step(params, opt_state, batch))
+        run = jax.jit(chunk)
+        per_call = fused_chunk
+    else:
+        run = step
+        per_call = 1
+
     for _ in range(3):  # absorb compile
-        params, opt_state, loss = step(params, opt_state, batch)
+        params, opt_state, loss = run(params, opt_state, batch)
     jax.block_until_ready(loss)
 
     steps = 0
     start = time.perf_counter()
     deadline = start + duration
     while time.perf_counter() < deadline:
-        params, opt_state, loss = step(params, opt_state, batch)
+        params, opt_state, loss = run(params, opt_state, batch)
         jax.block_until_ready(loss)
-        steps += 1
+        steps += per_call
     return steps / (time.perf_counter() - start)
 
 
@@ -164,7 +185,13 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
     from kubeshare_tpu.isolation.proxy import ChipProxy
     from kubeshare_tpu.isolation.tokensched import TokenScheduler
 
-    exclusive_sps = _exclusive_steps_per_sec(exclusive_s)
+    exclusive_plain = _exclusive_steps_per_sec(exclusive_s)
+    # The fused baseline costs an extra XLA compile (minutes on the CPU
+    # test backend) — only worth paying on a real measurement run.
+    exclusive_fused = (_exclusive_steps_per_sec(exclusive_s,
+                                                fused_chunk=chunk)
+                       if exclusive_s >= 2.0 else 0.0)
+    exclusive_sps = max(exclusive_plain, exclusive_fused)
     if settle_s is None:
         # Skip the startup transient, but never settle longer than we
         # measure (toy-duration test runs).
@@ -206,6 +233,8 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
         "unit": "fraction",
         "vs_baseline": round(ratio / 0.90, 4),
         "exclusive_steps_per_sec": round(exclusive_sps, 2),
+        "exclusive_plain_steps_per_sec": round(exclusive_plain, 2),
+        "exclusive_fused_steps_per_sec": round(exclusive_fused, 2),
         "colocated_aggregate_steps_per_sec": round(aggregate_sps, 2),
         "client_steps_per_sec": [round(a["steps_per_sec"], 2),
                                  round(b["steps_per_sec"], 2)],

@@ -38,6 +38,12 @@ class FakeTopology:
     model: str = "TPU-v4"
     memory: int = DEFAULT_FAKE_HBM
     host_prefix: str = "tpu-host"
+    #: hosts per ICI slice: 0 = single-host fleets with no slice identity
+    #: (standalone machines); N > 0 stamps ``slice_id`` so N-host groups
+    #: form ONE multi-host slice cell and separate groups stay SEPARATE
+    #: cells in ``config_from_chips`` — what live discovery reports via
+    #: ``d.slice_index`` (discovery.py:86)
+    hosts_per_slice: int = 0
 
     def chips(self) -> list[ChipInfo]:
         chips: list[ChipInfo] = []
@@ -46,6 +52,8 @@ class FakeTopology:
             per_host *= d
         for h in range(self.hosts):
             host = f"{self.host_prefix}-{h}"
+            slice_id = ("" if not self.hosts_per_slice
+                        else str(h // self.hosts_per_slice))
             for i in range(per_host):
                 coords = []
                 rem = i
@@ -61,6 +69,7 @@ class FakeTopology:
                     model=self.model,
                     memory=self.memory,
                     coords=tuple(coords),
+                    slice_id=slice_id,
                 ))
         return chips
 

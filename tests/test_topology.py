@@ -272,3 +272,34 @@ class TestDiscovery:
         chips = discover_chips("jax", host="testhost")
         assert len(chips) == 8  # conftest forces 8 virtual CPU devices
         assert all(c.host == "testhost" for c in chips)
+
+
+def test_config_from_chips_keeps_independent_slices_separate():
+    """Two discovery-reported ICI slices of the same shape must become TWO
+    slice cells (fusing them would let the scheduler hand a multi-host pod
+    a 'slice' with no ICI between its halves); hosts with no slice
+    identity keep fusing by shape as before."""
+    from kubeshare_tpu.topology.cellconfig import config_from_chips
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    chips = FakeTopology(hosts=4, mesh=(2, 2), model="TPU-v5e",
+                         hosts_per_slice=2).chips()
+    assert {c.slice_id for c in chips} == {"0", "1"}
+    cfg = config_from_chips(chips)
+    slice_cells = [c for c in cfg.cells
+                   if cfg.cell_types[c.cell_type].is_node_level is False]
+    assert len(slice_cells) == 2
+    for cell in slice_cells:
+        assert len(cell.children) == 2
+    # cell_ids are hierarchical ("<parent>/<host>"); compare the host part
+    members = [sorted(ch.cell_id.rsplit("/", 1)[-1] for ch in c.children)
+               for c in slice_cells]
+    assert sorted(members) == [["tpu-host-0", "tpu-host-1"],
+                               ["tpu-host-2", "tpu-host-3"]]
+
+    # no slice identity → same-shape hosts still fuse into one cell
+    plain = FakeTopology(hosts=4, mesh=(2, 2), model="TPU-v5e").chips()
+    cfg2 = config_from_chips(plain)
+    fused = [c for c in cfg2.cells
+             if cfg2.cell_types[c.cell_type].is_node_level is False]
+    assert len(fused) == 1 and len(fused[0].children) == 4
