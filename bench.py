@@ -250,7 +250,12 @@ def main(argv=None) -> int:
     # ≥ 3 accounting windows (WINDOW_MS = 10 s): shares cannot converge in
     # less — the round-2 default of 8 s was shorter than ONE window.
     parser.add_argument("--colocated-seconds", type=float, default=35.0)
-    parser.add_argument("--chunk", type=int, default=100,
+    # On the chip an mnist step is sub-microsecond (the MXU eats the tiny
+    # model), so a burst must fuse tens of thousands of steps before the
+    # ~0.3 ms dispatch+gate cost stops dominating; device time per burst
+    # stays a few ms — far under the 300 ms quantum, so preemption
+    # granularity is unaffected. CPU tests pass a small chunk explicitly.
+    parser.add_argument("--chunk", type=int, default=20000,
                         help="train steps fused per dispatch (one token burst)")
     parser.add_argument("--probe-timeout", type=float, default=180.0,
                         help="seconds to wait for backend init in the probe "
