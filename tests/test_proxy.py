@@ -507,3 +507,35 @@ def test_sliced_get_cache_is_per_handle(proxy, monkeypatch):
         np.testing.assert_array_equal(c.get(ba), a)
         np.testing.assert_array_equal(c.get(bb), b)
         np.testing.assert_array_equal(c.get(ba), a)
+
+
+def test_proxy_crash_fails_client_cleanly_and_resume_works():
+    """Fault injection the reference never had (SURVEY §5: 'no fault
+    injection'): the chip proxy dies mid-session; the client must get a
+    clean connection error (no hang), and a replacement proxy must accept
+    a re-register + re-put so training resumes from host state."""
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    p1 = ChipProxy(scheduler=sched)
+    p1.serve()
+    c = connect(p1, "phoenix")
+    w = c.put(np.float32(1.0))
+    loop = c.compile_loop(lambda w: (w + 1.0, w), w)
+    w, aux = loop(1, w)
+    c.free(aux)
+    host_w = float(c.get(w))           # checkpoint to host
+    p1.close()                          # crash
+
+    with pytest.raises((RuntimeError, OSError)):
+        c.get(w)                        # dead proxy: clean error, no hang
+    c.close()
+
+    p2 = ChipProxy(scheduler=TokenScheduler(WINDOW, BASE, MIN))
+    p2.serve()
+    try:
+        with connect(p2, "phoenix") as c2:   # same name: fresh incarnation
+            w2 = c2.put(np.float32(host_w))
+            loop2 = c2.compile_loop(lambda w: (w + 1.0, w), w2)
+            w2, aux2 = loop2(1, w2)
+            assert float(c2.get(w2)) == host_w + 1.0
+    finally:
+        p2.close()
