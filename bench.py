@@ -40,25 +40,34 @@ import time
 import numpy as np
 
 
-def _probe_backend(timeout_s: float) -> str | None:
+def _probe_backend(timeout_s: float, attempts: int = 3) -> str | None:
     """Initialize the JAX backend in a THROWAWAY subprocess first.
 
     A wedged axon tunnel hangs ``jax.devices()`` inside C code, where no
     Python-level timeout can interrupt it; probing in a child process turns
     that hang into a killable timeout and a diagnostic line instead of the
-    driver's rc=124. Returns an error string, or None when healthy.
+    driver's rc=124. The tunnel also FLAPS — observed healthy and wedged
+    seconds apart — so several shorter attempts beat one long wait.
+    Returns an error string, or None when healthy.
     """
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; d = jax.devices(); "
-             "print(d[0].platform, len(d))"],
-            capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return f"backend init hung > {timeout_s:.0f}s (tunnel wedged?)"
-    if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout).strip().splitlines()
-        return "backend init failed: " + (tail[-1] if tail else "unknown")
-    return None
+    per_try = max(30.0, timeout_s / attempts)
+    last = "unknown"
+    for _ in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; d = jax.devices(); "
+                 "print(d[0].platform, len(d))"],
+                capture_output=True, text=True, timeout=per_try)
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung > {per_try:.0f}s (tunnel wedged?)"
+            continue
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()
+            last = "backend init failed: " + (tail[-1] if tail else "unknown")
+            time.sleep(5.0)
+            continue
+        return None
+    return last
 
 
 def _exclusive_steps_per_sec(duration: float,
