@@ -16,7 +16,10 @@ from kubeshare_tpu.scheduler.labels import LabelError, parse_pod_labels
 from kubeshare_tpu.topology.discovery import FakeTopology
 
 EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
-BATTERY = sorted((EXAMPLES / "battery").glob("*.yaml"))
+# top-level examples carry # Expect: headers too — nothing in examples/
+# escapes validation
+BATTERY = sorted((EXAMPLES / "battery").glob("*.yaml")) + \
+    sorted(EXAMPLES.glob("*.yaml"))
 FAMILIES = sorted((EXAMPLES / "families").rglob("*.yaml"))
 
 
