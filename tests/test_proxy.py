@@ -539,3 +539,48 @@ def test_proxy_crash_fails_client_cleanly_and_resume_works():
             assert float(c2.get(w2)) == host_w + 1.0
     finally:
         p2.close()
+
+
+def test_idle_watchdog_races_gated_execution_stress():
+    """The advisor flagged proxy-side token state (holding/used) as the
+    spot most likely to breed deadlocks: the idle watchdog manipulates it
+    under sess.lock concurrently with _gated. Hammer that exact interleaving
+    — 4 clients, sub-burst idle_release, short window — and require
+    everyone to make steady progress with sane accounting."""
+    sched = TokenScheduler(window_ms=200.0, base_quota_ms=20.0,
+                           min_quota_ms=2.0)
+    p = ChipProxy(scheduler=sched, idle_release_ms=5.0)  # watchdog fires hot
+    p.serve()
+    errors: list = []
+    counts: dict = {}
+
+    def worker(name):
+        try:
+            with connect(p, name, request=0.25, limit=1.0) as c:
+                x = c.put(np.ones(16, np.float32))
+                exe = c.compile(lambda a: a + 1.0, x)
+                n = 0
+                deadline = time.monotonic() + 3.0
+                while time.monotonic() < deadline:
+                    x = exe(x, donate=True)
+                    n += 1
+                    if n % 7 == 0:
+                        time.sleep(0.012)  # go idle past idle_release_ms
+                counts[name] = n
+                u = c.usage()
+                assert u["exec_count"] == n + 0  # every dispatch accounted
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append((name, e))
+
+    threads = [threading.Thread(target=worker, args=(f"w{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads), "deadlock: worker stuck"
+    try:
+        assert not errors, errors
+        assert len(counts) == 4 and all(n > 10 for n in counts.values()), counts
+    finally:
+        p.close()
