@@ -20,10 +20,14 @@ from .layers import (
     avg_pool,
     max_pool,
 )
+from .fused_adam import adam_update, adam_update_reference, adam_update_tree
 from .losses import accuracy, softmax_cross_entropy
 
 __all__ = [
     "accuracy",
+    "adam_update",
+    "adam_update_reference",
+    "adam_update_tree",
     "avg_pool",
     "batchnorm_apply",
     "batchnorm_init",
