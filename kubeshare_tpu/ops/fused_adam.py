@@ -120,3 +120,33 @@ def adam_update_tree(params, grads, mu, nu, step, **hyper):
     unzip = lambda i: jax.tree_util.tree_unflatten(
         tree, [o[i] for o in out])
     return unzip(0), unzip(1), unzip(2)
+
+
+def fused_adam(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
+    """The kernel as an ``optax.GradientTransformation`` — a drop-in for
+    ``optax.adam`` anywhere the framework takes an optimizer (e.g.
+    ``models.common.run_training(optimizer=fused_adam(1e-3))``).
+
+    optax's contract returns *updates* rather than new params, so this
+    wrapper computes ``p_new - p`` — XLA folds the subtract/add pair away
+    under jit; callers that want the strictly zero-copy path use
+    :func:`adam_update_tree` directly.
+    """
+    import optax
+
+    def init(params):
+        zeros = lambda t: jax.tree_util.tree_map(jnp.zeros_like, t)
+        return {"count": jnp.zeros([], jnp.float32),
+                "mu": zeros(params), "nu": zeros(params)}
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam needs params")
+        count = state["count"] + 1.0
+        p_new, mu, nu = adam_update_tree(params, grads, state["mu"],
+                                         state["nu"], step=count,
+                                         lr=lr, b1=b1, b2=b2, eps=eps)
+        updates = jax.tree_util.tree_map(lambda n, o: n - o, p_new, params)
+        return updates, {"count": count, "mu": mu, "nu": nu}
+
+    return optax.GradientTransformation(init, update)

@@ -73,3 +73,15 @@ def test_tree_version_descends_loss():
                                           lr=1e-2)
         losses.append(float(l))
     assert losses[-1] < 0.5 * losses[0]
+
+
+def test_optax_wrapper_plugs_into_run_training():
+    """fused_adam() drops into the shared train machinery as-is."""
+    from kubeshare_tpu.models import mnist
+    from kubeshare_tpu.models.common import run_training
+    from kubeshare_tpu.ops.fused_adam import fused_adam
+
+    res = run_training(mnist.init, mnist.loss_fn, mnist.batch_fn,
+                       steps=8, optimizer=fused_adam(1e-3))
+    assert res.steps == 8
+    assert np.isfinite(res.final_loss)
