@@ -88,6 +88,28 @@ class SchedulerService:
         with self.dispatcher.lock:  # the loop thread mutates continuously
             return self._state_locked(eng)
 
+    def render_metrics(self) -> str:
+        """Scheduler-side Prometheus exposition (the reference's only
+        scheduler observability is log lines; SURVEY §5). Complements the
+        registry's load-bearing tpu_capacity/tpu_requirement families."""
+        d = self.dispatcher
+        with d.lock:
+            lines = [
+                "# TYPE kubeshare_scheduler_pending_pods gauge",
+                f"kubeshare_scheduler_pending_pods {len(d._pending)}",
+                "# TYPE kubeshare_scheduler_parked_pods gauge",
+                f"kubeshare_scheduler_parked_pods {len(d._parked)}",
+                "# TYPE kubeshare_scheduler_bound_pods gauge",
+                "kubeshare_scheduler_bound_pods "
+                f"{sum(1 for p in self.engine.pod_status.values() if p.node_name)}",
+                "# TYPE kubeshare_scheduler_nodes gauge",
+                f"kubeshare_scheduler_nodes {len(self.engine.chips_by_node)}",
+                "# TYPE kubeshare_scheduler_topology_rebuilds_total counter",
+                "kubeshare_scheduler_topology_rebuilds_total "
+                f"{self.engine.rebuild_count}",
+            ]
+        return "\n".join(lines) + "\n"
+
     @staticmethod
     def _state_locked(eng: SchedulerEngine) -> dict:
         return {
@@ -136,6 +158,15 @@ class SchedulerService:
             def do_GET(self):
                 if self.path == "/healthz":
                     return self._reply(200, {"ok": True})
+                if self.path == "/metrics":
+                    body = svc.render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/state":
                     return self._reply(200, svc.state())
                 parts = self.path.strip("/").split("/")

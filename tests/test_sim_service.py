@@ -199,3 +199,17 @@ def test_launch_backend_config_plumbs(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_service_metrics_exposition(service):
+    svc, registry = service
+    code, _ = 0, None
+    svc.schedule("ns", "m1", {C.POD_TPU_REQUEST: "0.5",
+                              C.POD_TPU_LIMIT: "1.0"})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics", timeout=5) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert "kubeshare_scheduler_bound_pods 1" in text
+    assert "kubeshare_scheduler_pending_pods 0" in text
+    assert "kubeshare_scheduler_nodes 1" in text
