@@ -213,3 +213,17 @@ def test_service_metrics_exposition(service):
     assert "kubeshare_scheduler_bound_pods 1" in text
     assert "kubeshare_scheduler_pending_pods 0" in text
     assert "kubeshare_scheduler_nodes 1" in text
+
+
+def test_simulator_synthetic_cli():
+    out = subprocess.run(
+        [sys.executable, "-m", "kubeshare_tpu.sim.simulator",
+         "--synthetic", "200", "--topology", "4:4x4@TPU-v5e"],
+        capture_output=True, text=True, cwd=REPO, check=True)
+    stats = json.loads(out.stdout.strip().splitlines()[-1])
+    assert stats["submitted"] == 200 and stats["failed"] == 0
+    # --trace and --synthetic are mutually exclusive
+    bad = subprocess.run(
+        [sys.executable, "-m", "kubeshare_tpu.sim.simulator"],
+        capture_output=True, text=True, cwd=REPO)
+    assert bad.returncode != 0
