@@ -148,3 +148,20 @@ def test_node_mesh_shape_and_select_block_units():
     block = select_block(free, 4, (2, 4))
     assert block is not None and len(block) == 4
     assert greedy_compact(free, 3, (2, 4)) is not None
+
+
+def test_3d_mesh_cube_block():
+    """v4-style 3D torus: an 8-chip pod on a 4x4x4 host gets a 2x2x2 cube
+    (minimal surface), not a 1x1x8 line or scattered chips."""
+    eng = build_engine(mesh=(4, 4, 4))
+    pod = eng.submit("ns", "cube", multi(8))
+    eng.schedule(pod)
+    coords = coords_of(pod, eng)
+    assert len(coords) == 8
+    spans = [max(c[a] for c in coords) - min(c[a] for c in coords)
+             for a in range(3)]
+    assert spans == [1, 1, 1], coords  # a 2x2x2 block on every axis
+
+    # the shape enumerator itself prefers the cube over flatter blocks
+    shapes = block_shapes(8, (4, 4, 4))
+    assert shapes[0] == (2, 2, 2)
