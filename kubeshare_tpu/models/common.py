@@ -76,12 +76,21 @@ def synthetic_token_batch(key, batch_size: int, seq_len: int,
 def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
                  steps: int, learning_rate: float = 1e-3, seed: int = 0,
                  warmup: int = 2, gate: Callable | None = None,
-                 optimizer: optax.GradientTransformation | None = None) -> TrainResult:
+                 optimizer: optax.GradientTransformation | None = None,
+                 checkpoint: str = "",
+                 checkpoint_every: int = 0) -> TrainResult:
     """Train for ``steps`` timed steps on one fixed synthetic batch.
 
     ``warmup`` untimed steps absorb compile time; each timed step blocks on
     device completion so steps/sec reflects real chip time. ``gate()`` (if
     given) runs before every step — the isolation client's token round-trip.
+
+    ``checkpoint`` (a directory path) enables crash-resume: an existing
+    checkpoint there is restored before training (its step count reduces
+    the remaining work) and state is saved every ``checkpoint_every``
+    steps (default: once at the end). A restarted pod with the same args
+    continues the same trajectory — the restartable-filler-work premise
+    of the opportunistic tier.
     """
     key = jax.random.PRNGKey(seed)
     pkey, bkey = jax.random.split(key)
@@ -91,19 +100,35 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
     step = make_train_step(loss_fn, optimizer)
     batch = batch_fn(bkey)
 
+    done = 0
+    if checkpoint:
+        from .checkpoint import load_checkpoint, save_checkpoint
+        try:
+            params, opt_state, done = load_checkpoint(checkpoint, params,
+                                                      opt_state)
+        except FileNotFoundError:
+            pass
+
     loss = jnp.zeros(())
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch)
     jax.block_until_ready(loss)
 
+    remaining = max(0, steps - done)
     start = time.perf_counter()
-    for _ in range(steps):
+    for i in range(1, remaining + 1):
         if gate is not None:
             gate()
         params, opt_state, loss = step(params, opt_state, batch)
         jax.block_until_ready(loss)
+        if (checkpoint and checkpoint_every
+                and i % checkpoint_every == 0):
+            save_checkpoint(checkpoint, params, opt_state, done + i)
     elapsed = time.perf_counter() - start
-    return TrainResult(steps=steps, seconds=elapsed, final_loss=float(loss))
+    if checkpoint:
+        save_checkpoint(checkpoint, params, opt_state, done + remaining)
+    return TrainResult(steps=remaining, seconds=elapsed,
+                       final_loss=float(loss))
 
 
 def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None) -> TrainResult:
@@ -114,10 +139,23 @@ def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None) -> TrainRes
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--checkpoint", default="",
+                        help="checkpoint dir: resume from it if present, "
+                             "save into it while training")
+    parser.add_argument("--checkpoint-every", type=int, default=0)
+    parser.add_argument("--platform", default="",
+                        help="force a JAX platform (e.g. 'cpu') — needed "
+                             "because the image config pins the platform "
+                             "list regardless of JAX_PLATFORMS")
     args = parser.parse_args(argv)
 
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
     result = run_training(init_fn, loss_fn, batch_fn, args.steps,
-                          learning_rate=args.lr, seed=args.seed)
+                          learning_rate=args.lr, seed=args.seed,
+                          checkpoint=args.checkpoint,
+                          checkpoint_every=args.checkpoint_every)
     print(f"{model_name}: {result.steps} steps in {result.seconds:.2f}s "
           f"= {result.steps_per_sec:.2f} steps/s, final loss {result.final_loss:.4f}")
     return result

@@ -121,3 +121,20 @@ def test_checkpoint_save_resume_roundtrip(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         load_checkpoint(tmp_path / "nope", like_p, optimizer.init(like_p))
+
+
+def test_cli_resume_skips_done_steps(tmp_path):
+    """`--checkpoint` on the model CLI: a rerun with the same args resumes
+    and only runs the remaining steps."""
+    import subprocess, sys
+    from pathlib import Path
+    repo = Path(__file__).resolve().parent.parent
+    ckpt = tmp_path / "ck"
+    cmd = [sys.executable, "-m", "kubeshare_tpu.models.mnist",
+           "--steps", "6", "--checkpoint", str(ckpt), "--platform", "cpu"]
+    out1 = subprocess.run(cmd, capture_output=True, text=True, cwd=repo,
+                          check=True)
+    assert "6 steps" in out1.stdout
+    out2 = subprocess.run(cmd, capture_output=True, text=True, cwd=repo,
+                          check=True)
+    assert "0 steps" in out2.stdout   # all done: nothing left to run
