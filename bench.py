@@ -190,9 +190,13 @@ def _proxied_trainer(proxy_port: int, name: str, request: float, limit: float,
 
 def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
               settle_s: float | None = None) -> dict:
+    import jax
+
     from kubeshare_tpu.constants import WINDOW_MS
     from kubeshare_tpu.isolation.proxy import ChipProxy
     from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    platform = jax.devices()[0].platform
 
     exclusive_plain = _exclusive_steps_per_sec(exclusive_s)
     # The fused baseline costs an extra XLA compile (minutes on the CPU
@@ -250,6 +254,7 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
         "share_error_pct": round(share_error_pct, 2),
         "colocated_seconds": round(colocated_s, 1),
         "windows_measured": round(colocated_s * 1000.0 / WINDOW_MS, 1),
+        "platform": platform,
     }
 
 
