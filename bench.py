@@ -109,14 +109,18 @@ def _exclusive_steps_per_sec(duration: float,
 
     for _ in range(3):  # absorb compile
         params, opt_state, loss = run(params, opt_state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     steps = 0
     start = time.perf_counter()
     deadline = start + duration
     while time.perf_counter() < deadline:
         params, opt_state, loss = run(params, opt_state, batch)
-        jax.block_until_ready(loss)
+        # float(loss) is a HOST READ — the only true completion barrier on
+        # the tunnelled axon backend, where block_until_ready returns while
+        # the program is still running (a 16384-step burst "completed" in
+        # 0.13 ms under it; with the host read it honestly takes ~2 s).
+        float(loss)
         steps += per_call
     return steps / (time.perf_counter() - start)
 
@@ -141,11 +145,19 @@ def _proxied_trainer(proxy_port: int, name: str, request: float, limit: float,
         params = optax.apply_updates(params, updates)
         return (params, opt_state), loss
 
-    key = jax.random.PRNGKey(hash(name) % (1 << 31))
-    pkey, bkey = jax.random.split(key)
-    host_params = mnist.init(pkey)
-    host_opt = optimizer.init(host_params)
-    host_batch = mnist.batch_fn(bkey)
+    # Build the initial state ENTIRELY on the host backend: client threads
+    # must never touch the chip — only the proxy owns it. Two threads
+    # driving the axon transport concurrently (eager dispatch or
+    # device→host pulls) deadlock inside it — observed as the >520 s bench
+    # wedge, both clients stuck in Array.__array__ resp. threefry_split.
+    # Ops run where their operands live, so the PRNGKey itself must be
+    # created under the cpu default_device too.
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        key = jax.random.PRNGKey(hash(name) % (1 << 31))
+        pkey, bkey = jax.random.split(key)
+        host_params = mnist.init(pkey)
+        host_opt = optimizer.init(host_params)
+        host_batch = mnist.batch_fn(bkey)
 
     with ProxyClient("127.0.0.1", proxy_port, name, request, limit) as c:
         carry = (c.put_tree(jax.tree_util.tree_map(np.asarray, host_params)),

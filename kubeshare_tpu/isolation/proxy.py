@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -46,27 +47,38 @@ def _now_ms() -> float:
 
 
 @dataclass
+class _Program:
+    """Per-PROGRAM state, shared across sessions by blob hash.
+
+    Identical clients (the common co-location case: N replicas of one
+    training script) export byte-identical StableHLO; compiling and
+    cost-profiling per session would pay every multi-second XLA compile
+    N times — on the tunnelled v5e a chunk compile is ~9 s, so two clients
+    churning through three buckets each burned the entire measurement
+    window of BENCH r3 in compiles.
+    """
+    # AOT-compiled single call + fused loops, one per STATIC power-of-two
+    # trip count (lazy; at most log2(max burst) entries). Static because a
+    # dynamic trip count defeats pjit's fast path on the transport backend.
+    single: object = None
+    chunks: dict = field(default_factory=dict)
+    # Burst cost model: burst_ms ≈ step_ms + (n-1) * loop_step_ms. The two
+    # are tracked separately because the FIRST call carries the transport's
+    # fixed dispatch+completion latency (~68 ms through the axon tunnel —
+    # the dominant cost) while in-loop iterations only pay device time.
+    step_ms: float = 0.0          # EMA of single-call time (incl. fixed lat.)
+    loop_step_ms: float = 0.0     # EMA of per-iteration time INSIDE the loop
+
+
+@dataclass
 class _Executable:
     exec_id: int
     call: object                  # the raw exported call (traceable)
     in_specs: list                # ShapeDtypeStruct per arg
     out_nbytes: int               # total output allocation, pre-checked
     out_meta: list[tuple[list[int], str]]  # (shape, dtype) per output
+    prog: _Program                # compiled artifacts + cost, sha-shared
     ncarry: int | None = None     # loop programs: first ncarry args/outs thread
-    fn: object = None             # AOT-compiled single call (lazy)
-    # AOT-compiled fused loops, one per STATIC power-of-two trip count
-    # (lazy; at most log2(max burst) entries). Static because a dynamic
-    # trip count costs ~60 ms fixed + ~0.1 ms/iteration on the TPU
-    # transport backend — measured 79 ms/call where the static-bound
-    # program runs the identical 100 steps in 0.24 ms.
-    chunks: dict = field(default_factory=dict)
-    # Burst cost model: burst_ms ≈ step_ms + (n-1) * loop_step_ms. The two
-    # are tracked separately because XLA may run a while-loop body at a
-    # different speed than straight-line code (dramatically so on CPU,
-    # where loop bodies lose intra-op threading) — one blended EMA makes
-    # the burst cap oscillate between too long and too short.
-    step_ms: float = 0.0          # EMA of first-iteration / single-call time
-    loop_step_ms: float = 0.0     # EMA of per-iteration time INSIDE the loop
 
 
 @dataclass
@@ -104,6 +116,38 @@ def _bucket(n: int) -> int:
     return 1 << (max(1, int(n)).bit_length() - 1)
 
 
+class _FifoLock:
+    """A FIFO mutex. ``threading.Lock`` lets a fast acquire/release loop
+    barge past parked waiters indefinitely (futex wake favors the running
+    thread) — under the device lock that starves a client whose first-time
+    compile is queued behind another client's hot execute loop. Handing the
+    lock to the longest waiter bounds everyone's wait by the queue length.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._waiters: deque[threading.Event] = deque()
+        self._held = False
+
+    def __enter__(self):
+        with self._mu:
+            if not self._held and not self._waiters:
+                self._held = True
+                return self
+            ev = threading.Event()
+            self._waiters.append(ev)
+        ev.wait()  # ownership is handed off in release — no re-race
+        return self
+
+    def __exit__(self, *exc):
+        with self._mu:
+            if self._waiters:
+                self._waiters.popleft().set()
+            else:
+                self._held = False
+        return False
+
+
 class HBMError(RuntimeError):
     pass
 
@@ -136,6 +180,21 @@ class ChipProxy:
         self.idle_release_ms = idle_release_ms
         self._sessions: dict[str, _Session] = {}
         self._slock = threading.Lock()
+        # Serializes ALL device interactions (put/get/compile/execute).
+        # The chip is single-tenant and its transport is not safe under
+        # concurrent driving from multiple threads — on the tunnelled axon
+        # backend two concurrent transfers deadlock inside the C layer.
+        # Executions are already exclusive via the token gate; this lock is
+        # taken INSIDE the gate (never around it), so there is no ordering
+        # cycle with the scheduler's own blocking.
+        self._dlock = _FifoLock()
+        # blob-sha → _Program: compiled artifacts + burst cost model shared
+        # across sessions (guarded by _slock for lookup; compiles race-safe
+        # under _dlock). LRU-capped: a client churning unique programs must
+        # not grow the proxy without bound — evicted programs just
+        # recompile on next use.
+        self._programs: "dict[str, _Program]" = {}
+        self._programs_cap = 32
         self.total_execs = 0          # lifetime, survives session drops
         self._server: protocol.FramedServer | None = None
         self._stop = threading.Event()
@@ -217,8 +276,14 @@ class ChipProxy:
 
     # -- token gate ----------------------------------------------------------
 
-    def _gated(self, sess: _Session, fn):
+    def _gated(self, sess: _Session, fn, timing: dict | None = None):
         """Run ``fn()`` under the chip token (Gemini burst semantics).
+
+        ``timing``: if given, ``fn`` records its device-only time there as
+        ``exec_ms`` (time after acquiring the device lock) and THAT is what
+        gets charged — wall time around ``fn()`` would bill a client for
+        waiting on another connection's put/compile holding ``_dlock``,
+        blowing its window limit through no usage of its own.
 
         On quota exhaustion the token is *renewed* — an atomic
         release + re-request in the scheduler — rather than released and
@@ -249,7 +314,9 @@ class ChipProxy:
             try:
                 result = fn()
             finally:
-                elapsed = _now_ms() - start
+                wall = _now_ms() - start
+                elapsed = (timing.get("exec_ms", wall)
+                           if timing is not None else wall)
                 with sess.lock:
                     sess.used_ms += elapsed
                     sess.exec_count += 1
@@ -356,7 +423,8 @@ class ChipProxy:
                 # (or the handle is freed), so at most one host copy lives
                 # per session regardless of how the client paces its reads.
                 if sess.fetch_cache is None or sess.fetch_cache[0] != handle:
-                    sess.fetch_cache = (handle, dump_array(buf))
+                    with self._dlock:
+                        sess.fetch_cache = (handle, dump_array(buf))
                 blob = sess.fetch_cache[1]
                 off, length = int(req["offset"]), int(req["length"])
                 if off < 0 or length <= 0:
@@ -373,7 +441,8 @@ class ChipProxy:
                 raise ValueError(
                     f"buffer too large to transfer ({int(buf.nbytes)} bytes);"
                     " fetch it in slices (get with offset/length)")
-            state["reply_blob"] = dump_array(buf)
+            with self._dlock:
+                state["reply_blob"] = dump_array(buf)
             return {"ok": True}
 
         if op == "free":
@@ -411,7 +480,8 @@ class ChipProxy:
         # refused before touching the device at all...
         self._charge(sess, arr.nbytes)
         sess.hbm_used -= arr.nbytes
-        buf = self._jax.device_put(arr, self.device)
+        with self._dlock:
+            buf = self._jax.device_put(arr, self.device)
         try:
             # ...then account the *device* buffer: device_put
             # canonicalizes dtypes (e.g. int64→int32 with x64 off), so
@@ -427,6 +497,8 @@ class ChipProxy:
 
     def _compile(self, sess: _Session, blob: bytes,
                  ncarry: int | None = None) -> dict:
+        import hashlib
+
         from jax import export
         exported = export.deserialize(blob)
         out_meta = [(list(a.shape), str(a.dtype)) for a in exported.out_avals]
@@ -435,10 +507,31 @@ class ChipProxy:
             for shape, dtype in out_meta)
         in_specs = [self._jax.ShapeDtypeStruct(a.shape, a.dtype)
                     for a in exported.in_avals]
+        # Program identity = the STRIPPED StableHLO text: the serialized
+        # blob embeds source locations (the client's compile_loop call
+        # site!), so hashing it raw would defeat sharing between identical
+        # clients started from different scripts/lines. Alias'd locs are
+        # `loc(#locN)` refs plus `#locN = loc(...)` definition lines — both
+        # carry no program semantics. ncarry is part of the identity: the
+        # chunk program's donation and carry threading differ per ncarry
+        # even for an identical module.
+        import re
+        text = exported.mlir_module()
+        text = re.sub(r"^#loc.*$", "", text, flags=re.MULTILINE)
+        text = re.sub(r"loc\(#loc\d*\)", "", text)
+        sha = hashlib.sha256(
+            text.encode() + f"|{ncarry}".encode()).hexdigest()
+        with self._slock:
+            prog = self._programs.pop(sha, None) or _Program()
+            self._programs[sha] = prog      # (re-)insert at MRU position
+            while len(self._programs) > self._programs_cap:
+                # Live _Executables keep their direct prog reference;
+                # eviction only stops FUTURE compiles from sharing it.
+                self._programs.pop(next(iter(self._programs)))
         exec_id = sess.fresh_id()
         sess.executables[exec_id] = _Executable(
             exec_id, exported.call, in_specs, out_nbytes, out_meta,
-            ncarry=None if ncarry is None else int(ncarry))
+            prog=prog, ncarry=None if ncarry is None else int(ncarry))
         return {"ok": True, "exec_id": exec_id,
                 "out_meta": out_meta, "out_nbytes": out_nbytes}
 
@@ -454,7 +547,7 @@ class ChipProxy:
         when the chip sits behind a transport (each step would re-ship the
         full parameter set).
         """
-        if exe.fn is None:
+        if exe.prog.single is None:
             from ..attach import real_jit
 
             call = exe.call
@@ -462,9 +555,11 @@ class ChipProxy:
             def _single(*args):
                 return call(*args)
 
-            exe.fn = (real_jit()(_single)
-                      .lower(*exe.in_specs).compile())
-        return exe.fn
+            with self._dlock:
+                if exe.prog.single is None:  # racing session lost; reuse
+                    exe.prog.single = (real_jit()(_single)
+                                       .lower(*exe.in_specs).compile())
+        return exe.prog.single
 
     def _chunk_fn(self, exe: _Executable, n: int):
         """``n`` executions fused into ONE XLA program via ``lax.fori_loop``
@@ -481,7 +576,7 @@ class ChipProxy:
         most log2(burst cap) compiles per program — and the trace cost is
         n-independent (the loop is not unrolled).
         """
-        fn = exe.chunks.get(n)
+        fn = exe.prog.chunks.get(n)
         if fn is None:
             from ..attach import real_jit
 
@@ -504,32 +599,51 @@ class ChipProxy:
             # The protocol always donates the carry (RemoteLoop frees those
             # handles on success), so give XLA the aliasing: without it a
             # training client needs 2x its state in HBM at every dispatch.
-            fn = (real_jit()(chunk, donate_argnums=tuple(range(ncarry)))
-                  .lower(*exe.in_specs).compile())
-            exe.chunks[n] = fn
+            with self._dlock:
+                fn = exe.prog.chunks.get(n)  # racing session lost; reuse
+                if fn is None:
+                    fn = (real_jit()(chunk,
+                                     donate_argnums=tuple(range(ncarry)))
+                          .lower(*exe.in_specs).compile())
+                    exe.prog.chunks[n] = fn
         return fn
 
     def _cap_repeat(self, exe: _Executable, repeat: int) -> int:
         """Clamp a client-requested burst length. The fused loop is one
         unpreemptible XLA execution, so an unbounded ``repeat`` would let a
         client monopolize the chip past its quota AND slip usage out of the
-        sliding window. Cap the estimated burst near the scheduler's base
-        quantum (Gemini's burst ≙ quota relationship). Before any timing
-        exists the burst must be bounded by *wall time*, and the only way to
-        bound an unknown step is to run exactly one: a steps-count cap
-        (e.g. 128) at 200 ms/step would be a 25 s unpreemptible burst, 80×
-        the base quota, blowing the client's whole limit window. The second
-        dispatch is a 2-step probe that seeds the in-loop estimate; from
-        then on ``n`` solves step + (n-1)·loop_step ≤ 2·base.
+        sliding window. Before any timing exists the burst must be bounded
+        by *wall time*, and the only way to bound an unknown step is to run
+        exactly one: a steps-count cap (e.g. 128) at 200 ms/step would be a
+        25 s unpreemptible burst, 80× the base quota, blowing the client's
+        whole limit window.
+
+        Sizing after that balances two costs. Fairness wants bursts near
+        the base quantum (Gemini's burst ≙ quota relationship); throughput
+        wants each burst to amortize the transport's FIXED per-dispatch
+        latency (~68 ms dispatch+completion through the tunnelled axon
+        backend, vs ~0.2 ms in-loop steps — a 600 ms cap would cap
+        efficiency at ~90%). So the budget is the larger of 2·base and
+        32·fixed-latency (≤3% overhead), bounded by a quarter of the
+        accounting window so shares still converge within a window.
+
+        The second dispatch sizes itself PESSIMISTICALLY (marginal cost
+        assumed = full single-call cost) instead of a hardcoded 2-step
+        probe: no XLA compile is wasted on a probe-sized bucket, which
+        matters at ~9 s per chunk compile on the tunnel.
         """
+        cost = exe.prog
+        if cost.step_ms <= 0.0:
+            return 1
         core = getattr(self.scheduler, "core", None)
         base = getattr(core, "base_quota_ms", 300.0)
-        budget = 2.0 * base
-        if exe.step_ms <= 0.0:
-            return 1
-        if exe.loop_step_ms <= 0.0:
-            return min(repeat, 2)
-        n = 1 + int(max(0.0, budget - exe.step_ms) / exe.loop_step_ms)
+        window = getattr(self.scheduler, "window_ms", 10_000.0)
+        if cost.loop_step_ms <= 0.0:
+            n = int(min(2.0 * base, window / 4.0) / cost.step_ms)
+            return max(1, min(repeat, n))
+        fixed = max(cost.step_ms - cost.loop_step_ms, 0.0)
+        budget = min(max(2.0 * base, 32.0 * fixed), window / 4.0)
+        n = 1 + int(max(0.0, budget - cost.step_ms) / cost.loop_step_ms)
         return max(1, min(repeat, n))
 
     def _execute(self, sess: _Session, req: dict) -> dict:
@@ -569,15 +683,16 @@ class ChipProxy:
         # transiently (donated buffers are freed only after success).
         self._charge(sess, exe.out_nbytes)
         exec_ms_before = sess.exec_ms_total
+        timing: dict = {}
 
         def run_tagged():
             try:
-                return self._run_fn(fn, args)
+                return self._run_fn(fn, args, timing)
             except Exception as e:
                 raise _ExecutionError(e) from e
 
         try:
-            outs = self._gated(sess, run_tagged)
+            outs = self._gated(sess, run_tagged, timing)
         except _ExecutionError as tagged:
             err = tagged.cause
             sess.hbm_used -= exe.out_nbytes
@@ -608,15 +723,18 @@ class ChipProxy:
         # estimate, and under contention _cap_repeat would then clamp
         # bursts far below the intended 2x base-quantum of device time.
         burst_ms = sess.exec_ms_total - exec_ms_before
-        if repeat == 1:
-            exe.step_ms = (burst_ms if exe.step_ms <= 0.0
-                           else 0.5 * exe.step_ms + 0.5 * burst_ms)
-        else:
-            first = exe.step_ms if exe.step_ms > 0.0 else burst_ms / repeat
-            per_loop = max(0.001, (burst_ms - first) / (repeat - 1))
-            exe.loop_step_ms = (per_loop if exe.loop_step_ms <= 0.0
-                                else 0.5 * exe.loop_step_ms + 0.5 * per_loop)
-        with self._slock:  # connection threads share this counter
+        cost = exe.prog
+        with self._slock:  # cost model + counter shared across connections
+            if repeat == 1:
+                cost.step_ms = (burst_ms if cost.step_ms <= 0.0
+                                else 0.5 * cost.step_ms + 0.5 * burst_ms)
+            else:
+                first = (cost.step_ms if cost.step_ms > 0.0
+                         else burst_ms / repeat)
+                per_loop = max(0.001, (burst_ms - first) / (repeat - 1))
+                cost.loop_step_ms = (
+                    per_loop if cost.loop_step_ms <= 0.0
+                    else 0.5 * cost.loop_step_ms + 0.5 * per_loop)
             self.total_execs += 1
         handles = []
         for out in outs:
@@ -629,11 +747,37 @@ class ChipProxy:
                 sess.hbm_used -= int(buf.nbytes)
         return {"ok": True, "handles": handles, "repeat": repeat}
 
-    def _run_fn(self, fn, args: list):
-        outs = fn(*args)
-        if not isinstance(outs, (list, tuple)):
-            outs = [outs]
-        self._jax.block_until_ready(outs)
+    def _run_fn(self, fn, args: list, timing: dict | None = None):
+        # _dlock inside the token gate: execution is already exclusive per
+        # the scheduler, but a concurrent put/get/compile from another
+        # connection must not drive the transport while this runs. Device
+        # time is measured AFTER the lock is ours — the wait belongs to
+        # whoever held the lock, not to this client's quota.
+        with self._dlock:
+            start = _now_ms()
+            try:
+                outs = fn(*args)
+                if not isinstance(outs, (list, tuple)):
+                    outs = [outs]
+                self._jax.block_until_ready(outs)
+                # block_until_ready is NOT a completion barrier on the
+                # tunnelled axon backend (observed: it returns while the
+                # program is still running, until transport backpressure
+                # kicks in) — which would zero out quota accounting and let
+                # a client queue bursts past its token. A host read of the
+                # smallest output cannot complete before the program does.
+                nonempty = [o for o in outs if getattr(o, "nbytes", 0) > 0]
+                if nonempty:  # all-empty outputs: block_until_ready only
+                    small = min(nonempty, key=lambda o: o.nbytes)
+                    if small.nbytes > 65536:
+                        # Don't haul a big buffer to host just to sync:
+                        # a 1-element slice is a dependent dispatch that
+                        # completes strictly after the program.
+                        small = small.ravel()[:1]
+                    np.asarray(small)
+            finally:
+                if timing is not None:
+                    timing["exec_ms"] = _now_ms() - start
         return list(outs)
 
     def _cleanup(self, state: dict) -> None:

@@ -8,7 +8,7 @@ CLI; ``common.run_training`` provides the timed loop with the isolation
 gate hook.
 """
 
-MODEL_NAMES = ("mnist", "cifar10", "lstm", "resnet", "vgg")
+MODEL_NAMES = ("mnist", "cifar10", "lstm", "resnet", "vgg", "transformer")
 
 
 def get_model(name: str):

@@ -112,7 +112,7 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
     loss = jnp.zeros(())
     for _ in range(warmup):
         params, opt_state, loss = step(params, opt_state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     remaining = max(0, steps - done)
     start = time.perf_counter()
@@ -120,7 +120,10 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
         if gate is not None:
             gate()
         params, opt_state, loss = step(params, opt_state, batch)
-        jax.block_until_ready(loss)
+        # Host read, not block_until_ready: the tunnelled axon backend's
+        # block returns before the program finishes, which would time
+        # dispatch rather than the step.
+        float(loss)
         if (checkpoint and checkpoint_every
                 and i % checkpoint_every == 0):
             save_checkpoint(checkpoint, params, opt_state, done + i)

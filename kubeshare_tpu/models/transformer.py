@@ -1,0 +1,90 @@
+"""Decoder-only transformer LM workload — the long-context model family.
+
+The reference's eval zoo stops at convnets + LSTM (``test/mnist`` etc.);
+long-context workloads are first-class in the TPU build, so the zoo grows
+a GPT-style causal LM. The attention inner function is pluggable: dense
+on one chip, ring attention over an ``sp`` mesh axis for sequence
+parallelism (``parallel.ringattention`` — pass ``attn_fn``).
+
+TPU-first notes: pre-norm residual blocks, all matmuls bfloat16 (MXU),
+layernorm/softmax accumulate fp32, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (dense_apply, dense_init, layernorm_apply, layernorm_init,
+                   mha_apply, mha_init, softmax_cross_entropy)
+from .common import main_cli, synthetic_token_batch
+
+BATCH_SIZE = 8
+SEQ_LEN = 256
+VOCAB = 4096
+DIM = 256
+HEADS = 8
+LAYERS = 4
+MLP_MULT = 4
+DTYPE = jnp.bfloat16
+
+
+def init(key, *, seq_len: int = SEQ_LEN, vocab: int = VOCAB, dim: int = DIM,
+         layers: int = LAYERS) -> dict:
+    ekey, pkey, okey, *bkeys = jax.random.split(key, 3 + layers)
+    blocks = []
+    for lkey in bkeys:
+        k1, k2, k3 = jax.random.split(lkey, 3)
+        blocks.append({
+            "ln1": layernorm_init(dim),
+            "attn": mha_init(k1, dim, HEADS),
+            "ln2": layernorm_init(dim),
+            "fc": dense_init(k2, dim, MLP_MULT * dim),
+            "proj": dense_init(k3, MLP_MULT * dim, dim),
+        })
+    return {
+        "embed": jax.random.normal(ekey, (vocab, dim)) * 0.02,
+        "pos": jax.random.normal(pkey, (seq_len, dim)) * 0.02,
+        "blocks": blocks,
+        "ln_f": layernorm_init(dim),
+        "out": dense_init(okey, dim, vocab),
+    }
+
+
+def apply(params: dict, tokens: jax.Array, attn_fn=None) -> jax.Array:
+    """``tokens``: (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+
+    ``attn_fn(q, k, v)`` overrides the dense causal attention — the
+    sequence-parallel path passes a ring-attention closure built on the
+    gang's mesh. The rest of the block is pointwise over the sequence, so
+    a ``P(dp, sp)`` token sharding flows through untouched; attention is
+    the only cross-sequence communication.
+    """
+    seq = tokens.shape[1]
+    x = (params["embed"][tokens] + params["pos"][:seq]).astype(DTYPE)
+    for blk in params["blocks"]:
+        x = x + mha_apply(blk["attn"], layernorm_apply(blk["ln1"], x),
+                          HEADS, causal=True, attn_fn=attn_fn,
+                          dtype=DTYPE).astype(DTYPE)
+        h = jax.nn.gelu(dense_apply(blk["fc"],
+                                    layernorm_apply(blk["ln2"], x),
+                                    dtype=DTYPE))
+        x = x + dense_apply(blk["proj"], h, dtype=DTYPE)
+    x = layernorm_apply(params["ln_f"], x)
+    return dense_apply(params["out"], x, dtype=DTYPE).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch, attn_fn=None) -> jax.Array:
+    tokens, targets = batch
+    return softmax_cross_entropy(apply(params, tokens, attn_fn=attn_fn),
+                                 targets)
+
+
+batch_fn = partial(synthetic_token_batch, batch_size=BATCH_SIZE,
+                   seq_len=SEQ_LEN, vocab=VOCAB)
+
+
+if __name__ == "__main__":
+    main_cli("transformer", init, loss_fn, batch_fn)

@@ -15,11 +15,14 @@ from .layers import (
     conv2d_init,
     dense_apply,
     dense_init,
+    layernorm_apply,
+    layernorm_init,
     lstm_apply,
     lstm_init,
     avg_pool,
     max_pool,
 )
+from .attention import dot_product_attention, mha_apply, mha_init
 from .fused_adam import adam_update, adam_update_reference, adam_update_tree
 from .losses import accuracy, softmax_cross_entropy
 
@@ -35,8 +38,13 @@ __all__ = [
     "conv2d_init",
     "dense_apply",
     "dense_init",
+    "dot_product_attention",
+    "layernorm_apply",
+    "layernorm_init",
     "lstm_apply",
     "lstm_init",
     "max_pool",
+    "mha_apply",
+    "mha_init",
     "softmax_cross_entropy",
 ]

@@ -1,0 +1,93 @@
+"""Multi-head attention as (init, apply) pairs, plus a dense reference
+softmax-attention kernel.
+
+The reference repo ships no attention code (its eval workloads are
+mnist/cifar/lstm/resnet/vgg torch images, ``test/mnist/mnist1.yaml:15``);
+long-context workloads are first-class in the TPU build, so the workload
+zoo grows a transformer family. Design notes (TPU-first):
+
+- ``dot_product_attention`` keeps the score matmuls in bfloat16-friendly
+  einsums (MXU) but runs the softmax accumulation in fp32.
+- The attention inner function is pluggable (``attn_fn``) so the same
+  transformer block runs dense on one chip or ring-parallel over an ``sp``
+  mesh axis (:mod:`kubeshare_tpu.parallel.ringattention`) without the
+  model knowing.
+- All shapes static; masking is ``jnp.where`` with a finite floor, not
+  ``-inf`` (NaN-safe under fp32 exp).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# Finite mask floor: low enough that exp(floor - m) underflows to 0 for any
+# realistic running max m, high enough that (floor - m) never overflows.
+MASK_VALUE = -1e30
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = True,
+                          scale: float | None = None) -> jax.Array:
+    """Dense reference attention.
+
+    ``q``: (batch, q_len, heads, head_dim); ``k``/``v``: (batch, kv_len,
+    heads, head_dim); returns (batch, q_len, heads, head_dim) in fp32.
+    The ring implementation is validated against this function.
+    """
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        nq, nk = scores.shape[1], scores.shape[-1]
+        # Align the mask to the END of the kv sequence (q_len may be a
+        # suffix of kv_len — not used by the models here, but the standard
+        # convention).
+        qidx = jnp.arange(nq) + (nk - nq)
+        mask = qidx[:, None] >= jnp.arange(nk)[None, :]
+        scores = jnp.where(mask[None, :, None, :], scores, MASK_VALUE)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", weights, v.astype(jnp.float32))
+
+
+def mha_init(key, dim: int, heads: int) -> dict:
+    """Fused-QKV multi-head attention parameters (dim must divide heads)."""
+    if dim % heads:
+        raise ValueError(f"dim {dim} not divisible by heads {heads}")
+    kq, ko = jax.random.split(key)
+    scale = math.sqrt(1.0 / dim)
+    return {
+        "qkv": jax.random.uniform(kq, (dim, 3 * dim), jnp.float32,
+                                  -scale, scale),
+        "out": jax.random.uniform(ko, (dim, dim), jnp.float32,
+                                  -scale, scale),
+    }
+
+
+def mha_apply(params: dict, x: jax.Array, heads: int, causal: bool = True,
+              attn_fn=None, dtype=None) -> jax.Array:
+    """Multi-head self-attention over ``x``: (batch, seq, dim).
+
+    ``attn_fn(q, k, v)`` defaults to causal :func:`dot_product_attention`;
+    the sequence-parallel path passes a ring-attention closure instead.
+    """
+    b, s, dim = x.shape
+    hd = dim // heads
+    w_qkv, w_out = params["qkv"], params["out"]
+    if dtype is not None:
+        x, w_qkv, w_out = (x.astype(dtype), w_qkv.astype(dtype),
+                           w_out.astype(dtype))
+    qkv = x @ w_qkv                       # (b, s, 3*dim) — one MXU matmul
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, heads, hd)
+    k = k.reshape(b, s, heads, hd)
+    v = v.reshape(b, s, heads, hd)
+    if attn_fn is None:
+        o = dot_product_attention(q, k, v, causal=causal)
+    else:
+        o = attn_fn(q, k, v)
+    o = o.reshape(b, s, dim).astype(w_out.dtype)
+    return o @ w_out

@@ -72,6 +72,22 @@ def avg_pool(x: jax.Array, window: int = 2, stride: int | None = None) -> jax.Ar
     return summed / (window * window)
 
 
+# --- layernorm ---------------------------------------------------------------
+
+def layernorm_init(dim: int) -> dict:
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def layernorm_apply(params: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Normalize the trailing axis in fp32 (bf16 variance loses too many
+    bits), then cast back to the input dtype."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    return (y * params["scale"] + params["bias"]).astype(x.dtype)
+
+
 # --- batchnorm (training-mode batch statistics) ------------------------------
 
 def batchnorm_init(ch: int) -> dict:

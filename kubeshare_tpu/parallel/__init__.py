@@ -7,13 +7,18 @@ from .mesh import (
     param_sharding,
     replicated,
     shard_init,
+    token_sharding,
 )
+from .ringattention import make_ring_attention, ring_attention_shard
 
 __all__ = [
     "data_sharding",
     "make_mesh",
+    "make_ring_attention",
     "make_sharded_train_step",
     "param_sharding",
     "replicated",
+    "ring_attention_shard",
     "shard_init",
+    "token_sharding",
 ]

@@ -51,6 +51,15 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp"))
 
 
+def token_sharding(mesh: Mesh) -> NamedSharding:
+    """Token batches (batch, seq): batch over dp, sequence over sp when the
+    mesh has a sequence axis — the long-context layout ring attention
+    consumes (``parallel.ringattention``)."""
+    if "sp" in mesh.axis_names:
+        return NamedSharding(mesh, P("dp", "sp"))
+    return NamedSharding(mesh, P("dp"))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -1,0 +1,114 @@
+"""Ring attention: sequence-parallel exact attention over an ``sp`` mesh axis.
+
+Long-context sequence parallelism is a first-class capability of the TPU
+build (the reference delegates all model math to its workload images,
+``test/distribute/**``). Each device holds one contiguous block of the
+sequence; key/value blocks rotate around the ring with ``lax.ppermute``
+(one ICI hop per step) while queries stay put, and the partial softmax is
+combined with the online (flash-attention style) running max / running sum
+update — so attention over the FULL sequence is exact, but no device ever
+materializes more than a (block × block) score tile, and the k/v transfer
+for step i+1 overlaps the compute for step i under XLA's async collectives.
+
+Memory per device: O(seq/sp · seq/sp) scores instead of O(seq²) — the
+point of the exercise for long contexts.
+
+Layout convention matches :mod:`kubeshare_tpu.ops.attention`:
+q/k/v are (batch, seq_shard, heads, head_dim) inside the shard; the global
+arrays are (batch, seq, heads, head_dim) sharded P(dp, sp, tp, None).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import MASK_VALUE
+
+
+def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str, causal: bool = True,
+                         scale: float | None = None) -> jax.Array:
+    """Per-shard ring attention body. MUST run inside ``shard_map`` (or
+    another SPMD context) where ``axis_name`` maps the sequence axis.
+
+    ``q``/``k``/``v``: (batch, block, heads, head_dim) — this device's
+    sequence block. Returns the attention output for the local queries
+    against the FULL (global) sequence, (batch, block, heads, head_dim),
+    fp32.
+    """
+    sp = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, nq, h, d = q.shape
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    qf = q.astype(jnp.float32)
+
+    # Ring: each step, ship our current k/v block one hop forward so after
+    # i steps this device holds block (me - i) mod sp. Every link carries
+    # one block per step — bandwidth-balanced on a torus ICI.
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(i, carry):
+        o, m, l, kblk, vblk = carry
+        src = jnp.mod(me - i, sp)          # which global block we hold now
+        scores = jnp.einsum("bqhd,bkhd->bqhk", qf,
+                            kblk.astype(jnp.float32)) * scale
+        if causal:
+            qidx = me * nq + jnp.arange(nq)
+            kidx = src * nq + jnp.arange(nq)
+            mask = qidx[:, None] >= kidx[None, :]
+            scores = jnp.where(mask[None, :, None, :], scores, MASK_VALUE)
+        # Online softmax combine. Fully-masked rows keep m at the floor;
+        # the explicit where() guards turn their exp(0)=1 into 0.
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.where(m > MASK_VALUE * 0.5,
+                          jnp.exp(m - m_new), 0.0)
+        p = jnp.where(scores > MASK_VALUE * 0.5,
+                      jnp.exp(scores - m_new[..., None]), 0.0)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = (o * alpha[..., None]
+                 + jnp.einsum("bqhk,bkhd->bqhd", p,
+                              vblk.astype(jnp.float32)))
+        kblk, vblk = lax.ppermute((kblk, vblk), axis_name, perm)
+        return o_new, m_new, l_new, kblk, vblk
+
+    # Derive the accumulators from qf so they carry the same
+    # varying-manual-axes type as the loop outputs (jax ≥0.8 shard_map
+    # rejects an unvarying init zipped with varying outputs).
+    o = qf * 0.0
+    m = qf.max(axis=-1) * 0.0 + MASK_VALUE
+    l = qf.sum(axis=-1) * 0.0
+    # sp is static at trace time → static trip count (no dynamic-trip
+    # dispatch cliff; see doc/bench-notes.md).
+    o, m, l, _, _ = lax.fori_loop(0, sp, step, (o, m, l, k, v),
+                                  unroll=True)
+    return o / jnp.where(l > 0.0, l, 1.0)[..., None]
+
+
+def make_ring_attention(mesh: Mesh, causal: bool = True,
+                        axis_name: str = "sp"):
+    """An ``attn_fn(q, k, v)`` over GLOBAL (batch, seq, heads, head_dim)
+    arrays, sequence-sharded over ``axis_name`` via ``shard_map``.
+
+    Batch rides ``dp`` and heads ride ``tp`` when those axes exist in the
+    mesh (purely local — no collectives on them); sequence is the ring.
+    Plug the result into :func:`kubeshare_tpu.ops.attention.mha_apply`.
+    """
+    names = set(mesh.axis_names)
+    if axis_name not in names:
+        raise ValueError(f"mesh {mesh.axis_names} has no {axis_name!r} axis")
+    bspec = "dp" if "dp" in names else None
+    hspec = "tp" if "tp" in names else None
+    spec = P(bspec, axis_name, hspec, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec)
+    def attn(q, k, v):
+        return ring_attention_shard(q, k, v, axis_name, causal=causal)
+
+    return attn

@@ -1,0 +1,183 @@
+"""Sequence-parallel ring attention + the transformer LM family.
+
+Validated on the 8-virtual-device CPU mesh (conftest) — the same
+fake-multichip story every other sharded test uses. The ring result must
+match dense attention EXACTLY (same math, different schedule), including
+gradients: this is the property that makes ring attention a drop-in for
+long contexts rather than an approximation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeshare_tpu.models import transformer
+from kubeshare_tpu.ops.attention import (dot_product_attention, mha_apply,
+                                         mha_init)
+from kubeshare_tpu.parallel.ringattention import make_ring_attention
+
+
+def mesh3(dp=2, sp=4, tp=1):
+    devs = np.array(jax.devices("cpu")[:dp * sp * tp]).reshape(dp, sp, tp)
+    return Mesh(devs, ("dp", "sp", "tp"))
+
+
+def qkv(b=4, s=32, h=2, d=8, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), jnp.float32)
+                 for k in keys)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(causal):
+    q, k, v = qkv()
+    ref = dot_product_attention(q, k, v, causal=causal)
+    ring = jax.jit(make_ring_attention(mesh3(), causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_matches_dense_heads_over_tp():
+    q, k, v = qkv(b=2, s=16, h=4, d=8)
+    m = mesh3(dp=1, sp=4, tp=2)
+    ref = dot_product_attention(q, k, v)
+    ring = jax.jit(make_ring_attention(m))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_gradients_match_dense():
+    q, k, v = qkv(s=16)
+    m = mesh3()
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v) ** 2).sum()
+
+    ring = make_ring_attention(m)
+
+    def loss_ring(q, k, v):
+        return (ring(q, k, v) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_rejects_missing_axis():
+    devs = np.array(jax.devices("cpu")[:4]).reshape(4)
+    m = Mesh(devs, ("dp",))
+    with pytest.raises(ValueError, match="no 'sp' axis"):
+        make_ring_attention(m)
+
+
+def test_mha_apply_with_ring_inside_jit():
+    """mha_apply(attn_fn=ring) under jit with sequence-sharded activations:
+    the block design's claim — attention is the ONLY cross-sequence comm —
+    holds iff this compiles and matches the dense path."""
+    m = mesh3(dp=2, sp=4)
+    key = jax.random.PRNGKey(1)
+    params = mha_init(key, dim=16, heads=2)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (4, 32, 16))
+    dense = mha_apply(params, x, heads=2)
+    ring = make_ring_attention(m)
+    xs = jax.device_put(x, NamedSharding(m, P("dp", "sp", None)))
+    out = jax.jit(lambda p, x: mha_apply(p, x, heads=2, attn_fn=ring))(
+        params, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=1e-4, rtol=1e-4)
+
+
+# --- transformer model family ------------------------------------------------
+
+def small_init(key):
+    return transformer.init(key, seq_len=32, vocab=64, dim=32, layers=2)
+
+
+def small_batch(key):
+    tokens = jax.random.randint(key, (4, 33), 0, 64)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_transformer_forward_and_loss():
+    key = jax.random.PRNGKey(0)
+    params = small_init(key)
+    batch = small_batch(jax.random.fold_in(key, 1))
+    logits = transformer.apply(params, batch[0])
+    assert logits.shape == (4, 32, 64)
+    assert logits.dtype == jnp.float32
+    loss = transformer.loss_fn(params, batch)
+    assert np.isfinite(float(loss))
+    assert float(loss) == pytest.approx(np.log(64), rel=0.25)
+
+
+def test_transformer_causality():
+    """Changing a future token must not change past logits."""
+    key = jax.random.PRNGKey(0)
+    params = small_init(key)
+    tokens, _ = small_batch(jax.random.fold_in(key, 1))
+    logits = transformer.apply(params, tokens)
+    perturbed = tokens.at[:, -1].set((tokens[:, -1] + 1) % 64)
+    logits2 = transformer.apply(params, perturbed)
+    np.testing.assert_allclose(np.asarray(logits[:, :-1]),
+                               np.asarray(logits2[:, :-1]),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_transformer_sequence_parallel_matches_dense():
+    """The long-context path: ring attention over sp, tokens sharded
+    P(dp, sp). Same logits as the single-device dense run."""
+    m = mesh3(dp=2, sp=4)
+    key = jax.random.PRNGKey(0)
+    params = small_init(key)
+    tokens, targets = small_batch(jax.random.fold_in(key, 1))
+    dense = transformer.apply(params, tokens)
+
+    ring = make_ring_attention(m)
+    toks = jax.device_put(tokens, NamedSharding(m, P("dp", "sp")))
+    out = jax.jit(lambda p, t: transformer.apply(p, t, attn_fn=ring))(
+        params, toks)
+    # bf16 activations: the two schedules round differently; logits are
+    # fp32 at the end but the block outputs were bf16 either way.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=5e-2, rtol=5e-2)
+
+    loss = jax.jit(
+        lambda p, b: transformer.loss_fn(p, b, attn_fn=ring))(
+            params, (toks, jax.device_put(
+                targets, NamedSharding(m, P("dp", "sp")))))
+    assert np.isfinite(float(loss))
+
+
+def test_transformer_train_step_sp_grads_flow():
+    """One optimizer step under dp x sp sharding: loss drops and every
+    parameter receives a finite gradient through the ring."""
+    import optax
+
+    m = mesh3(dp=2, sp=4)
+    key = jax.random.PRNGKey(0)
+    params = small_init(key)
+    tokens, targets = small_batch(jax.random.fold_in(key, 1))
+    sh = NamedSharding(m, P("dp", "sp"))
+    batch = (jax.device_put(tokens, sh), jax.device_put(targets, sh))
+    ring = make_ring_attention(m)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: transformer.loss_fn(p, batch, attn_fn=ring))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, grads
+
+    params, opt_state, loss0, grads = step(params, opt_state, batch)
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+    assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+    for _ in range(3):
+        params, opt_state, loss, _ = step(params, opt_state, batch)
+    assert float(loss) < float(loss0)
