@@ -32,18 +32,28 @@ DTYPE = jnp.bfloat16
 
 
 def init(key, *, seq_len: int = SEQ_LEN, vocab: int = VOCAB, dim: int = DIM,
-         layers: int = LAYERS) -> dict:
+         layers: int = LAYERS, n_experts: int = 0) -> dict:
+    """``n_experts > 0`` swaps every block's dense FFN for a top-1 routed
+    mixture of experts (``ops.moe``) — the expert-parallel family; shard
+    the expert stacks with :func:`kubeshare_tpu.ops.moe.expert_sharding`.
+    """
+    from ..ops.moe import moe_init
+
     ekey, pkey, okey, *bkeys = jax.random.split(key, 3 + layers)
     blocks = []
     for lkey in bkeys:
         k1, k2, k3 = jax.random.split(lkey, 3)
-        blocks.append({
+        block = {
             "ln1": layernorm_init(dim),
             "attn": mha_init(k1, dim, HEADS),
             "ln2": layernorm_init(dim),
-            "fc": dense_init(k2, dim, MLP_MULT * dim),
-            "proj": dense_init(k3, MLP_MULT * dim, dim),
-        })
+        }
+        if n_experts:
+            block["moe"] = moe_init(k2, dim, MLP_MULT * dim, n_experts)
+        else:
+            block["fc"] = dense_init(k2, dim, MLP_MULT * dim)
+            block["proj"] = dense_init(k3, MLP_MULT * dim, dim)
+        blocks.append(block)
     return {
         "embed": jax.random.normal(ekey, (vocab, dim)) * 0.02,
         "pos": jax.random.normal(pkey, (seq_len, dim)) * 0.02,
@@ -53,8 +63,10 @@ def init(key, *, seq_len: int = SEQ_LEN, vocab: int = VOCAB, dim: int = DIM,
     }
 
 
-def apply(params: dict, tokens: jax.Array, attn_fn=None) -> jax.Array:
-    """``tokens``: (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+def apply(params: dict, tokens: jax.Array, attn_fn=None,
+          return_aux: bool = False):
+    """``tokens``: (batch, seq) int32 → logits (batch, seq, vocab) fp32
+    (with ``return_aux``: ``(logits, moe_aux_loss)``).
 
     ``attn_fn(q, k, v)`` overrides the dense causal attention — the
     sequence-parallel path passes a ring-attention closure built on the
@@ -62,24 +74,35 @@ def apply(params: dict, tokens: jax.Array, attn_fn=None) -> jax.Array:
     a ``P(dp, sp)`` token sharding flows through untouched; attention is
     the only cross-sequence communication.
     """
+    from ..ops.moe import moe_apply
+
     seq = tokens.shape[1]
     x = (params["embed"][tokens] + params["pos"][:seq]).astype(DTYPE)
+    aux_total = jnp.zeros((), jnp.float32)
     for blk in params["blocks"]:
         x = x + mha_apply(blk["attn"], layernorm_apply(blk["ln1"], x),
                           HEADS, causal=True, attn_fn=attn_fn,
                           dtype=DTYPE).astype(DTYPE)
-        h = jax.nn.gelu(dense_apply(blk["fc"],
-                                    layernorm_apply(blk["ln2"], x),
-                                    dtype=DTYPE))
-        x = x + dense_apply(blk["proj"], h, dtype=DTYPE)
+        hin = layernorm_apply(blk["ln2"], x)
+        if "moe" in blk:
+            ffn, aux = moe_apply(blk["moe"], hin, dtype=DTYPE)
+            aux_total = aux_total + aux
+        else:
+            h = jax.nn.gelu(dense_apply(blk["fc"], hin, dtype=DTYPE))
+            ffn = dense_apply(blk["proj"], h, dtype=DTYPE)
+        x = x + ffn
     x = layernorm_apply(params["ln_f"], x)
-    return dense_apply(params["out"], x, dtype=DTYPE).astype(jnp.float32)
+    logits = dense_apply(params["out"], x, dtype=DTYPE).astype(jnp.float32)
+    return (logits, aux_total) if return_aux else logits
+
+
+AUX_COEF = 0.01  # Switch load-balance coefficient
 
 
 def loss_fn(params: dict, batch, attn_fn=None) -> jax.Array:
     tokens, targets = batch
-    return softmax_cross_entropy(apply(params, tokens, attn_fn=attn_fn),
-                                 targets)
+    logits, aux = apply(params, tokens, attn_fn=attn_fn, return_aux=True)
+    return softmax_cross_entropy(logits, targets) + AUX_COEF * aux
 
 
 batch_fn = partial(synthetic_token_batch, batch_size=BATCH_SIZE,

@@ -9,16 +9,22 @@ from .mesh import (
     shard_init,
     token_sharding,
 )
+from .pipeline import (make_pipeline, microbatch, pipeline_shard,
+                       stage_sharding)
 from .ringattention import make_ring_attention, ring_attention_shard
 
 __all__ = [
     "data_sharding",
     "make_mesh",
+    "make_pipeline",
     "make_ring_attention",
     "make_sharded_train_step",
+    "microbatch",
     "param_sharding",
+    "pipeline_shard",
     "replicated",
     "ring_attention_shard",
     "shard_init",
+    "stage_sharding",
     "token_sharding",
 ]
