@@ -23,6 +23,7 @@ from .layers import (
     max_pool,
 )
 from .attention import dot_product_attention, mha_apply, mha_init
+from .flash_attention import flash_attention
 from .fused_adam import adam_update, adam_update_reference, adam_update_tree
 from .losses import accuracy, softmax_cross_entropy
 
@@ -39,6 +40,7 @@ __all__ = [
     "dense_apply",
     "dense_init",
     "dot_product_attention",
+    "flash_attention",
     "layernorm_apply",
     "layernorm_init",
     "lstm_apply",

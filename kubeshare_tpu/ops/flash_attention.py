@@ -1,0 +1,166 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+Dense attention materializes the (seq × seq) score matrix in HBM; the
+flash schedule streams key/value BLOCKS through VMEM and folds them into
+the output with the online-softmax update, so HBM traffic is O(seq·d)
+and the only score tile ever alive is (block_q × block_k) — exactly the
+memory argument that makes long contexts fit. This kernel is the
+single-chip sibling of :mod:`kubeshare_tpu.parallel.ringattention`
+(same math, the ring distributes the k/v loop over chips; this kernel
+blocks it over VMEM).
+
+Grid: (batch·head, q-blocks, k-blocks) with the k dimension innermost —
+each program sees ONE (block_q × d) q tile and ONE (block_k × d) k/v
+tile, so VMEM usage is independent of sequence length; the fp32 running
+max/sum/accumulator live in VMEM scratch and carry across the k steps
+(the q/out tiles are revisited, Pallas keeps them resident). Fully
+masked causal blocks (k entirely above the diagonal) are predicated off
+with ``pl.when`` — the causal path does ~half the MXU work.
+
+Differentiable via ``custom_vjp``: the backward recomputes through the
+dense reference (O(seq²) peak on the BACKWARD only — fine at the
+sequence lengths a single chip trains; long-context training is the ring
+path's job). The public entry falls back to interpreter mode off-TPU, so
+CPU CI runs the identical kernel body.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import MASK_VALUE, dot_product_attention
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            block_q: int, block_k: int, n_k: int, causal: bool,
+            scale: float):
+    """One (q-block, k-block) step. Scratch m/l/acc carry across the
+    innermost (k) grid dimension."""
+    j = pl.program_id(1)          # q block
+    kk = pl.program_id(2)         # k block (innermost, sequential)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: the whole k block is masked iff its first row starts after
+    # the q block's last query. Predicating the update off skips the two
+    # matmuls — about half the causal FLOPs.
+    q_end = (j + 1) * block_q - 1
+    live = jnp.logical_or(not causal, kk * block_k <= q_end)
+
+    @pl.when(live)
+    def _update():
+        qb = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+        kb = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        vb = v_ref[0].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
+        if causal:
+            qpos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            kpos = kk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            sc = jnp.where(qpos >= kpos, sc, MASK_VALUE)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+        alpha = jnp.where(m > MASK_VALUE * 0.5, jnp.exp(m - m_new), 0.0)
+        p = jnp.where(sc > MASK_VALUE * 0.5, jnp.exp(sc - m_new), 0.0)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] / jnp.where(l > 0.0, l, 1.0)
+                    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    if s % bq or s % bk:
+        raise ValueError(f"seq {s} must be divisible by blocks {bq}/{bk}")
+    n_k = s // bk
+    # (b, s, h, d) → (b·h, s, d): one grid row per batch·head.
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_q=bq, block_k=bk, n_k=n_k,
+                          causal=causal, scale=scale),
+        grid=(b * h, s // bq, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running sum
+            pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret), \
+        (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: dot_product_attention(q, k, v, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = BLOCK_Q,
+                    block_k: int = BLOCK_K,
+                    interpret: bool | None = None) -> jax.Array:
+    """Drop-in for :func:`~kubeshare_tpu.ops.attention.dot_product_attention`
+    (same (batch, seq, heads, head_dim) layout, fp32 output).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter
+    elsewhere (the interpreter runs the identical kernel body, so CPU CI
+    covers it bit-for-bit). Plug into ``mha_apply(attn_fn=...)`` /
+    ``transformer.apply`` for the single-chip long-context path.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, bool(interpret))
