@@ -2,7 +2,10 @@
 
 One module per reference eval workload (``/root/reference/test/**``):
 ``mnist`` (north-star benchmark), ``cifar10``, ``lstm``, ``resnet``,
-``vgg``. Each exposes ``init(key)``, ``loss_fn(params, batch)``,
+``vgg`` — plus ``transformer``, the long-context causal-LM family the
+TPU build adds (dense or mixture-of-experts FFN, pluggable attention:
+dense / Pallas flash / sequence-parallel ring). Each exposes
+``init(key)``, ``loss_fn(params, batch)``,
 ``batch_fn(key)`` and a ``python -m kubeshare_tpu.models.<name> --steps N``
 CLI; ``common.run_training`` provides the timed loop with the isolation
 gate hook.

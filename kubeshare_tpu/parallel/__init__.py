@@ -2,6 +2,7 @@
 
 from .mesh import (
     data_sharding,
+    make_hybrid_mesh,
     make_mesh,
     make_sharded_train_step,
     param_sharding,
@@ -15,6 +16,7 @@ from .ringattention import make_ring_attention, ring_attention_shard
 
 __all__ = [
     "data_sharding",
+    "make_hybrid_mesh",
     "make_mesh",
     "make_pipeline",
     "make_ring_attention",

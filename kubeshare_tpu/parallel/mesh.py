@@ -19,6 +19,15 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _default_tp(n: int) -> int:
+    """Largest power-of-two ≤ √n that divides n — a square-ish split that
+    keeps tensor-parallel collectives on near-neighbor ICI links."""
+    tp = 1 << (int(math.isqrt(n)).bit_length() - 1) if n > 1 else 1
+    while n % tp:
+        tp //= 2
+    return tp
+
+
 def make_mesh(devices=None, dp: int | None = None, tp: int | None = None) -> Mesh:
     """Build a 2D ``(dp, tp)`` mesh over *devices* (default: all).
 
@@ -32,9 +41,7 @@ def make_mesh(devices=None, dp: int | None = None, tp: int | None = None) -> Mes
         if axis is not None and axis <= 0:
             raise ValueError(f"{name} must be positive, got {axis}")
     if dp is None and tp is None:
-        tp = 1 << (int(math.isqrt(n)).bit_length() - 1) if n > 1 else 1
-        while n % tp:
-            tp //= 2
+        tp = _default_tp(n)
         dp = n // tp
     elif dp is None:
         dp = n // tp
@@ -46,18 +53,22 @@ def make_mesh(devices=None, dp: int | None = None, tp: int | None = None) -> Mes
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch arrays: split along the leading axis over dp, replicated
-    over tp."""
+    """Batch arrays: split along the leading axis over every data axis the
+    mesh has (``dcn`` and/or ``dp``), replicated over tp."""
+    if "dcn" in mesh.axis_names:
+        return NamedSharding(mesh, P(("dcn", "dp")))
     return NamedSharding(mesh, P("dp"))
 
 
 def token_sharding(mesh: Mesh) -> NamedSharding:
-    """Token batches (batch, seq): batch over dp, sequence over sp when the
-    mesh has a sequence axis — the long-context layout ring attention
-    consumes (``parallel.ringattention``)."""
+    """Token batches (batch, seq): batch over every data axis (dcn and/or
+    dp), sequence over sp when the mesh has a sequence axis — the
+    long-context layout ring attention consumes
+    (``parallel.ringattention``)."""
+    batch_axes = (("dcn", "dp") if "dcn" in mesh.axis_names else "dp")
     if "sp" in mesh.axis_names:
-        return NamedSharding(mesh, P("dp", "sp"))
-    return NamedSharding(mesh, P("dp"))
+        return NamedSharding(mesh, P(batch_axes, "sp"))
+    return NamedSharding(mesh, P(batch_axes))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -107,3 +118,38 @@ def shard_init(init_fn: Callable, key, mesh: Mesh):
     params = init_fn(key)
     shardings = param_sharding(mesh, params)
     return jax.device_put(params, shardings)
+
+
+def make_hybrid_mesh(device_slices, tp: int | None = None) -> Mesh:
+    """Mesh spanning MULTIPLE slices: axes ``(dcn, dp, tp)``.
+
+    ``device_slices``: list of per-slice device lists (e.g. grouped by the
+    ``slice_id`` discovery reports). The ``dcn`` axis crosses slice
+    boundaries — only data-parallel gradient reductions ride it — while
+    ``dp``/``tp`` stay inside a slice, so tensor-parallel collectives
+    (all-gather/reduce-scatter per layer) never leave ICI. This is the
+    standard two-tier layout for multi-host scale-out: DCN is orders of
+    magnitude slower than ICI, so the mesh puts the once-per-step psum
+    there and nothing else.
+
+    All slices must be the same size (the gang scheduler's contiguous
+    whole-slice allocation guarantees this for placed workloads).
+    """
+    sizes = {len(d) for d in device_slices}
+    if len(sizes) != 1:
+        raise ValueError(f"slices must be equal-sized, got {sorted(sizes)}")
+    per = sizes.pop()
+    if per == 0:
+        raise ValueError("empty slices")
+    if tp is None:
+        tp = _default_tp(per)
+    elif tp <= 0:
+        raise ValueError(f"tp must be positive, got {tp}")
+    if per % tp:
+        raise ValueError(f"tp={tp} does not divide slice size {per}")
+    dp = per // tp
+    arr = np.array([list(d) for d in device_slices], dtype=object)
+    return Mesh(arr.reshape(len(device_slices), dp, tp),
+                ("dcn", "dp", "tp"))
+
+
