@@ -78,7 +78,8 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
                  warmup: int = 2, gate: Callable | None = None,
                  optimizer: optax.GradientTransformation | None = None,
                  checkpoint: str = "",
-                 checkpoint_every: int = 0) -> TrainResult:
+                 checkpoint_every: int = 0,
+                 profile_dir: str = "") -> TrainResult:
     """Train for ``steps`` timed steps on one fixed synthetic batch.
 
     ``warmup`` untimed steps absorb compile time; each timed step blocks on
@@ -114,19 +115,25 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
         params, opt_state, loss = step(params, opt_state, batch)
     float(loss)
 
+    import contextlib
+    # Profile ONLY the timed loop: init/compile/warmup/checkpoint events
+    # would otherwise dwarf the steady-state steps in the trace.
+    trace_ctx = (jax.profiler.trace(profile_dir) if profile_dir
+                 else contextlib.nullcontext())
     remaining = max(0, steps - done)
     start = time.perf_counter()
-    for i in range(1, remaining + 1):
-        if gate is not None:
-            gate()
-        params, opt_state, loss = step(params, opt_state, batch)
-        # Host read, not block_until_ready: the tunnelled axon backend's
-        # block returns before the program finishes, which would time
-        # dispatch rather than the step.
-        float(loss)
-        if (checkpoint and checkpoint_every
-                and i % checkpoint_every == 0):
-            save_checkpoint(checkpoint, params, opt_state, done + i)
+    with trace_ctx:
+        for i in range(1, remaining + 1):
+            if gate is not None:
+                gate()
+            params, opt_state, loss = step(params, opt_state, batch)
+            # Host read, not block_until_ready: the tunnelled axon
+            # backend's block returns before the program finishes, which
+            # would time dispatch rather than the step.
+            float(loss)
+            if (checkpoint and checkpoint_every
+                    and i % checkpoint_every == 0):
+                save_checkpoint(checkpoint, params, opt_state, done + i)
     elapsed = time.perf_counter() - start
     if checkpoint:
         save_checkpoint(checkpoint, params, opt_state, done + remaining)
@@ -150,6 +157,10 @@ def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None) -> TrainRes
                         help="force a JAX platform (e.g. 'cpu') — needed "
                              "because the image config pins the platform "
                              "list regardless of JAX_PLATFORMS")
+    parser.add_argument("--profile", default="",
+                        help="capture an XLA/TPU profiler trace of the "
+                             "timed loop into this directory (view with "
+                             "tensorboard / xprof)")
     args = parser.parse_args(argv)
 
     if args.platform:
@@ -158,7 +169,8 @@ def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None) -> TrainRes
     result = run_training(init_fn, loss_fn, batch_fn, args.steps,
                           learning_rate=args.lr, seed=args.seed,
                           checkpoint=args.checkpoint,
-                          checkpoint_every=args.checkpoint_every)
+                          checkpoint_every=args.checkpoint_every,
+                          profile_dir=args.profile)
     print(f"{model_name}: {result.steps} steps in {result.seconds:.2f}s "
           f"= {result.steps_per_sec:.2f} steps/s, final loss {result.final_loss:.4f}")
     return result

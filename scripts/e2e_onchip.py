@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""The SURVEY §7.2 minimum end-to-end slice, on the REAL chip.
+
+One chip proxy owns the TPU; two UNMODIFIED ``python -m
+kubeshare_tpu.models.mnist`` processes attach through environment
+variables alone (sitecustomize shim on PYTHONPATH — the reference's
+LD_PRELOAD contract, ``pkg/scheduler/pod.go:445-457``) at
+``tpu_request=0.5`` each and train concurrently. Prints per-pod steps/s
+and the proxy's device-time split.
+
+Run from the repo root on a TPU host::
+
+    python scripts/e2e_onchip.py [--steps 200]
+
+Exit 0 iff both pods finish and the device-time split is within 10% of
+the requested 50/50.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SHIM = REPO / "kubeshare_tpu" / "_shim"
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--timeout", type=float, default=480.0)
+    args = parser.parse_args()
+
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.isolation.proxy import ChipProxy
+
+    proxy = ChipProxy()  # grabs the default device — the real chip here
+    proxy.serve()
+    print(f"proxy owns {proxy.device} on port {proxy.port}", flush=True)
+
+    outs: dict[str, subprocess.CompletedProcess] = {}
+
+    failures: dict[str, str] = {}
+
+    def pod(name: str) -> None:
+        env = dict(
+            os.environ,
+            PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
+            **{
+                C.ENV_CHIP_PROXY_PORT: str(proxy.port),
+                C.ENV_POD_NAME: name,
+                C.ENV_TPU_REQUEST: "0.5",
+                C.ENV_TPU_LIMIT: "1.0",
+            },
+        )
+        try:
+            outs[name] = subprocess.run(
+                [sys.executable, "-m", "kubeshare_tpu.models.mnist",
+                 "--steps", str(args.steps)],
+                capture_output=True, text=True, env=env,
+                timeout=args.timeout, cwd=str(REPO))
+        except Exception as exc:  # timeout or spawn failure = test failure
+            failures[name] = f"{type(exc).__name__}: {exc}"
+
+    threads = [threading.Thread(target=pod, args=(f"pod-{x}",))
+               for x in "ab"]
+    for t in threads:
+        t.start()
+
+    # Sample device-time while both sessions are live (they drop at
+    # disconnect, so the split must be captured mid-run).
+    import time
+    split: dict[str, float] = {}
+    while any(t.is_alive() for t in threads):
+        snap = {s.name: s.exec_ms_total
+                for s in list(proxy._sessions.values())}
+        if len(snap) == 2:
+            split = snap
+        time.sleep(1.0)
+    for t in threads:
+        t.join()
+
+    ok = not failures
+    for name, err in sorted(failures.items()):
+        print(f"{name}: FAILED {err}", flush=True)
+    for name, proc in sorted(outs.items()):
+        line = [l for l in proc.stdout.splitlines() if "steps/s" in l]
+        print(f"{name}: rc={proc.returncode} {line[0] if line else ''}",
+              flush=True)
+        if proc.returncode != 0:
+            print(proc.stderr[-1500:], flush=True)
+            ok = False
+
+    print(f"proxy lifetime executions: {proxy.total_execs}")
+    proxy.close()
+    if not split:
+        print("FAIL: never sampled both sessions live — run more --steps")
+        return 1
+    total = sum(split.values())
+    share = max(split.values()) / total if total else 1.0
+    print(f"device-time split: { {k: round(v, 1) for k, v in split.items()} }"
+          f" -> max share {share:.3f} (target 0.5 ± 0.1)")
+    return 0 if ok and share <= 0.60 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
