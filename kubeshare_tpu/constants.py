@@ -32,6 +32,7 @@ POD_TPU_MODEL = DOMAIN + "tpu_model"
 # --- scheduler-written annotations (constants.go:25-27) ---------------------
 POD_TPU_CHIP_ID = DOMAIN + "tpu_chip_id"     # ≙ sharedgpu/gpu_uuid
 POD_CELL_ID = DOMAIN + "cell_id"
+POD_GROUP_RANK = DOMAIN + "group_rank"       # survives engine restarts
 POD_MANAGER_PORT = DOMAIN + "tpu_manager_port"
 
 # --- environment contract into the workload container -----------------------
@@ -54,6 +55,14 @@ ENV_TPU_REQUEST = "KUBESHARE_TPU_REQUEST"
 ENV_TPU_LIMIT = "KUBESHARE_TPU_LIMIT"
 ENV_TPU_MEMORY = "KUBESHARE_TPU_MEM"
 ENV_ATTACH_MODE = "KUBESHARE_TPU_ATTACH"  # proxy | gate | off (default auto)
+# Gang/distributed contract (≙ the reference's torchelastic env in its
+# distribute manifests): the scheduler injects group identity + size +
+# this member's rank; the COORDINATOR address is wired by the manifest
+# (e.g. a headless service on rank 0) and consumed by parallel.runner.
+ENV_GROUP_NAME = "KUBESHARE_TPU_GROUP"
+ENV_NUM_PROCESSES = "KUBESHARE_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "KUBESHARE_TPU_PROCESS_ID"
+ENV_COORDINATOR = "KUBESHARE_TPU_COORDINATOR"
 
 # Library/host paths (pod.go:23-26, cmd/kubeshare-query-ip/main.go:22-34).
 LIBRARY_PATH = "/var/lib/kubeshare-tpu/library"

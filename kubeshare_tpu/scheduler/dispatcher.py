@@ -70,7 +70,9 @@ def _binding_of(pod: PodRequest) -> Binding:
     return Binding(pod.key, pod.node_name, list(pod.chip_ids),
                    [c.id for c in pod.cells],
                    [c.cell_type for c in pod.cells], pod.memory, pod.port,
-                   request=pod.request, limit=pod.limit)
+                   request=pod.request, limit=pod.limit,
+                   group=pod.group_name, group_size=pod.headcount,
+                   group_rank=pod.group_rank)
 
 
 class Dispatcher:

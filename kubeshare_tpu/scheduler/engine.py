@@ -67,6 +67,9 @@ class Binding:
     port: int = 0                 # 0 for whole-chip pods (no manager)
     request: float = 0.0          # share params, re-injected as env for
     limit: float = 0.0            # the zero-touch attach shim
+    group: str = ""               # gang identity + this member's slot —
+    group_size: int = 0           # the jax.distributed contract
+    group_rank: int = -1          # (parallel.runner reads these)
 
     @property
     def annotations(self) -> dict[str, str]:
@@ -78,6 +81,11 @@ class Binding:
         }
         if self.port:
             ann[C.POD_MANAGER_PORT] = str(self.port)
+        if self.group_rank >= 0:
+            # Written back so resync after an engine restart restores the
+            # SAME rank — a replacement member must never collide with a
+            # live container whose env already says a given process_id.
+            ann[C.POD_GROUP_RANK] = str(self.group_rank)
         return ann
 
     @property
@@ -92,6 +100,19 @@ class Binding:
             env[C.ENV_TPU_REQUEST] = str(self.request)
             env[C.ENV_TPU_LIMIT] = str(self.limit)
             env[C.ENV_TPU_MEMORY] = str(self.memory)
+        if self.group:
+            env[C.ENV_GROUP_NAME] = self.group
+        if self.group_rank >= 0:
+            # FULL gangs only (threshold 1): jax.distributed needs the
+            # exact process count at init, and a partial gang released at
+            # min_available < headcount would hang every member waiting
+            # for processes the scheduler never intends to place. Partial
+            # gangs get the group name only (their elasticity story is
+            # the workload's, as in the reference's torchelastic
+            # manifests). Coordinator address is the manifest's job
+            # (headless service on rank 0) — see parallel/runner.py.
+            env[C.ENV_NUM_PROCESSES] = str(self.group_size)
+            env[C.ENV_PROCESS_ID] = str(self.group_rank)
         return env
 
 
@@ -328,9 +349,28 @@ class SchedulerEngine:
     def reserve(self, pod: PodRequest, node_name: str) -> Binding:
         """Pick cells, book them, allocate the manager port, emit the
         binding (Reserve, scheduler.go:489-531 + pod.go:348-476)."""
+        full_gang = (pod.group_name
+                     and pod.min_available == pod.headcount)
+        if full_gang and pod.group_rank < 0:
+            # Smallest free rank in the gang (freed on unreserve/delete):
+            # the distributed runner uses it as jax.distributed
+            # process_id, so it must be unique and dense in
+            # [0, headcount). All ranks held (e.g. a replacement arriving
+            # before the dead member's delete event) → unschedulable
+            # until a rank frees, never a duplicate or out-of-range id.
+            taken = {m.group_rank for m in self._group_members(pod)
+                     if m.group_rank >= 0}
+            free = [r for r in range(pod.headcount) if r not in taken]
+            if not free:
+                raise Unschedulable(
+                    f"{pod.key}: all {pod.headcount} ranks of gang "
+                    f"{pod.group_name} are held; delete a member first")
+            pod.group_rank = free[0]
+        group_kw = dict(group=pod.group_name, group_size=pod.headcount,
+                        group_rank=pod.group_rank) if pod.group_name else {}
         if not pod.needs_tpu:
             pod.node_name = node_name
-            return Binding(pod.key, node_name, [], [], [], 0)
+            return Binding(pod.key, node_name, [], [], [], 0, **group_kw)
         cells = select_cells(self.free_list, node_name, pod,
                              self.chip_priority, self._group_cells(pod),
                              self.mesh_shape)
@@ -354,7 +394,8 @@ class SchedulerEngine:
             pod.memory = memory
             return Binding(pod.key, node_name, pod.chip_ids,
                            [c.id for c in cells],
-                           [c.cell_type for c in cells], memory)
+                           [c.cell_type for c in cells], memory,
+                           **group_kw)
         cell = cells[0]
         memory_defaulted = pod.memory == 0
         if memory_defaulted:
@@ -378,7 +419,7 @@ class SchedulerEngine:
         pod.port = C.POD_MANAGER_PORT_START + offset
         return Binding(pod.key, node_name, pod.chip_ids, [cell.id],
                        [cell.cell_type], pod.memory, pod.port,
-                       request=pod.request, limit=pod.limit)
+                       request=pod.request, limit=pod.limit, **group_kw)
 
     def unreserve(self, pod: PodRequest) -> list[str]:
         """Roll back a reservation; returns group members that should be
@@ -412,6 +453,7 @@ class SchedulerEngine:
             if cell is not None:
                 reclaim_resource(cell, compute, memory)
         pod.bookings = []
+        pod.group_rank = -1       # rank returns to the gang's free pool
         if pod.port:
             self.ports[pod.node_name].unmask(
                 pod.port - C.POD_MANAGER_PORT_START)
@@ -473,6 +515,11 @@ class SchedulerEngine:
         pod.cells = cells
         pod.chip_ids = [c.chip_id for c in cells]
         pod.memory = memory
+        rank = annotations.get(C.POD_GROUP_RANK, "")
+        if rank != "":
+            # The live container's env already carries this process_id —
+            # restoring it keeps replacements from colliding with it.
+            pod.group_rank = int(rank)
         port = int(annotations.get(C.POD_MANAGER_PORT, "0") or 0)
         if (C.POD_MANAGER_PORT_START <= port
                 < C.POD_MANAGER_PORT_START + C.POD_MANAGER_PORT_RANGE

@@ -61,6 +61,7 @@ class PodRequest:
 
     group_name: str = ""
     headcount: int = 0
+    group_rank: int = -1          # assigned at reserve, freed at reclaim
     threshold: float = 0.0
     min_available: int = 0
 
