@@ -15,33 +15,49 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_LOCK = threading.Lock()
+# (src basename, src mtime) of builds that FAILED: don't re-run a broken
+# compile on every spawn — retry only when the source changes.
+_FAILED: set[tuple[str, float]] = set()
 
 
-def build_library(name: str) -> str | None:
-    """Compile ``<name>.cpp`` into ``_build/lib<name>.so`` (cached by mtime).
-
-    Returns the .so path, or None when no C++ toolchain is available —
-    callers fall back to their pure-Python implementation.
-    """
+def _build(name: str, out_name: str, flags: list[str]) -> str | None:
+    """Compile ``<name>.cpp`` into ``_build/<out_name>`` (cached by mtime;
+    failures negatively cached per source mtime). Returns the output path,
+    or None when no toolchain / compile error — callers fall back to their
+    pure-Python implementation."""
     src = os.path.join(_HERE, f"{name}.cpp")
-    build_dir = os.path.join(_HERE, "_build")
-    lib = os.path.join(build_dir, f"lib{name}.so")
+    out = os.path.join(_HERE, "_build", out_name)
     with _BUILD_LOCK:
-        if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
-            return lib
-        os.makedirs(build_dir, exist_ok=True)
-        cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-o", lib, src]
+        src_mtime = os.path.getmtime(src)
+        if os.path.exists(out) and os.path.getmtime(out) >= src_mtime:
+            return out
+        if (name, src_mtime) in _FAILED:
+            return None
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        cmd = ["g++", "-std=c++17", "-O2", *flags, "-o", out, src]
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
         except (FileNotFoundError, subprocess.CalledProcessError) as e:
+            _FAILED.add((name, src_mtime))
             detail = getattr(e, "stderr", "") or str(e)
             from ...utils.logger import get_logger
             get_logger("isolation").warning(
-                "native build of %s failed (%s); using Python fallback", name, detail)
+                "native build of %s failed (%s); using Python fallback",
+                name, detail)
             return None
-    return lib
+    return out
+
+
+def build_library(name: str) -> str | None:
+    """``<name>.cpp`` → ``_build/lib<name>.so`` for ctypes loading."""
+    return _build(name, f"lib{name}.so", ["-shared", "-fPIC"])
 
 
 def load_library(name: str) -> ctypes.CDLL | None:
     lib = build_library(name)
     return ctypes.CDLL(lib) if lib else None
+
+
+def build_binary(name: str) -> str | None:
+    """``<name>.cpp`` → the standalone executable ``_build/<name>``."""
+    return _build(name, name, ["-pthread"])

@@ -1,0 +1,334 @@
+// Native per-pod manager: the gem-pmgr equivalent as a standalone C++
+// binary (the reference's pod manager is native C++, spawned per sharing
+// pod by the launcher — docker/kubeshare-gemini-scheduler/launcher.py:41-56).
+//
+// Speaks the framed-JSON protocol (4-byte big-endian length + UTF-8 JSON,
+// kubeshare_tpu/isolation/protocol.py): registers the pod on the token
+// scheduler at startup, serves the workload's ExecutionGate on
+// POD_MANAGER_PORT, and relays acquire/renew/release/usage with the pod
+// identity injected. Each downstream connection gets its OWN upstream
+// connection (a shared one would deadlock: a blocked acquire holds the
+// channel while another gate's release can never get through), and a
+// downstream that dies while holding the token has it released with wall
+// time charged up to the granted quota — a crashed pod must not starve
+// the chip nor run rings around its limit.
+//
+// JSON handling is deliberately protocol-shaped, not a general parser:
+// the peer is our own json.dumps output; we extract the "op" string and
+// "quota_ms" number, and inject "name" before the closing brace (JSON's
+// last-duplicate-wins makes the injected identity authoritative).
+//
+// Build: g++ -std=c++17 -O2 -pthread (see native/__init__.py
+// build_binary); the Python twin kubeshare_tpu/isolation/podmgr.py is the
+// fallback and the behavioral reference — tests run both against the same
+// scheduler and assert identical observable behavior.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+
+namespace {
+
+std::atomic<bool> g_stop{false};
+
+double now_ms() {
+  using namespace std::chrono;
+  return duration<double, std::milli>(steady_clock::now().time_since_epoch())
+      .count();
+}
+
+bool recv_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k < 0 && errno == EINTR) continue;  // signal ≠ disconnect
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool recv_frame(int fd, std::string& out) {
+  uint32_t be = 0;
+  if (!recv_exact(fd, &be, 4)) return false;
+  uint32_t size = ntohl(be);
+  if (size > (1u << 30)) return false;
+  out.resize(size);
+  return size == 0 || recv_exact(fd, out.data(), size);
+}
+
+bool send_frame(int fd, const std::string& msg) {
+  uint32_t be = htonl(static_cast<uint32_t>(msg.size()));
+  return send_all(fd, &be, 4) && send_all(fd, msg.data(), msg.size());
+}
+
+// Extract the string value of a top-level key ("op") — peer frames are
+// json.dumps output, so the key appears exactly once, quoted.
+std::string json_str(const std::string& j, const std::string& key) {
+  std::string pat = "\"" + key + "\"";
+  size_t k = j.find(pat);
+  if (k == std::string::npos) return "";
+  size_t c = j.find(':', k + pat.size());
+  if (c == std::string::npos) return "";
+  size_t q1 = j.find('"', c + 1);
+  if (q1 == std::string::npos) return "";
+  std::string out;
+  for (size_t i = q1 + 1; i < j.size(); ++i) {
+    char ch = j[i];
+    if (ch == '\\' && i + 1 < j.size()) {
+      out.push_back(j[++i]);  // good enough for identifier-ish values
+    } else if (ch == '"') {
+      return out;
+    } else {
+      out.push_back(ch);
+    }
+  }
+  return "";
+}
+
+double json_num(const std::string& j, const std::string& key, double dflt) {
+  std::string pat = "\"" + key + "\"";
+  size_t k = j.find(pat);
+  if (k == std::string::npos) return dflt;
+  size_t c = j.find(':', k + pat.size());
+  if (c == std::string::npos) return dflt;
+  return std::strtod(j.c_str() + c + 1, nullptr);
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') out.push_back('\\');
+    out.push_back(ch);
+  }
+  return out;
+}
+
+// Inject/override "name" (JSON last-duplicate-wins on the Python side).
+std::string with_name(const std::string& req, const std::string& name) {
+  size_t brace = req.rfind('}');
+  if (brace == std::string::npos) return req;
+  return req.substr(0, brace) + ", \"name\": \"" + json_escape(name) +
+         "\"}" + req.substr(brace + 1);
+}
+
+int dial(const std::string& host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+struct Config {
+  std::string sched_ip = "127.0.0.1";
+  int sched_port = 0;
+  int port = 0;
+  std::string pod_name;
+  double request = 0.0;
+  double limit = 0.0;
+};
+
+bool rpc(int fd, const std::string& msg, std::string& reply) {
+  return send_frame(fd, msg) && recv_frame(fd, reply);
+}
+
+void serve_conn(const Config& cfg, int down) {
+  // Workers must not receive the stop signals — delivery to a worker
+  // would both fake a downstream disconnect and leave the main thread
+  // parked in accept() with g_stop set.
+  sigset_t mask;
+  sigemptyset(&mask);
+  sigaddset(&mask, SIGTERM);
+  sigaddset(&mask, SIGINT);
+  pthread_sigmask(SIG_BLOCK, &mask, nullptr);
+
+  int up = -1;
+  bool holding = false;
+  double quota_ms = 0.0, grant_t = 0.0;
+  std::string req, reply;
+  while (!g_stop.load() && recv_frame(down, req)) {
+    std::string op = json_str(req, "op");
+    if (op == "register") {
+      send_frame(down, "{\"ok\": true, \"name\": \"" +
+                           json_escape(cfg.pod_name) + "\"}");
+      continue;
+    }
+    if (op == "acquire" || op == "renew" || op == "release" ||
+        op == "usage") {
+      if (up < 0) {
+        up = dial(cfg.sched_ip, cfg.sched_port);
+        if (up < 0 ||
+            !rpc(up, with_name("{\"op\": \"attach\"}", cfg.pod_name),
+                 reply)) {
+          send_frame(down, "{\"ok\": false, \"error\": \"scheduler "
+                           "unreachable\"}");
+          break;
+        }
+      }
+      if (!rpc(up, with_name(req, cfg.pod_name), reply)) break;
+      if (op == "acquire" || op == "renew") {
+        // Only a successful grant means we hold the token — an ok:false
+        // reply (wait timeout, client removed) must not arm the
+        // crash-release path for a token this pod never held.
+        double q = json_num(reply, "quota_ms", -1.0);
+        if (q >= 0.0 && reply.find("\"ok\": true") != std::string::npos) {
+          holding = true;
+          quota_ms = q;
+          grant_t = now_ms();
+        }
+      } else if (op == "release") {
+        holding = false;
+      }
+      if (!send_frame(down, reply)) break;
+      continue;
+    }
+    send_frame(down, "{\"ok\": false, \"error\": \"unknown op\"}");
+  }
+  if (holding && up >= 0) {
+    // Crash-release: charge wall time since the grant, capped at quota.
+    double used = now_ms() - grant_t;
+    if (used < 0) used = 0;
+    if (used > quota_ms) used = quota_ms;
+    char buf[160];
+    std::snprintf(buf, sizeof(buf),
+                  "{\"op\": \"release\", \"used_ms\": %.3f, \"name\": "
+                  "\"%s\"}",
+                  used, json_escape(cfg.pod_name).c_str());
+    std::string r;
+    rpc(up, buf, r);
+  }
+  if (up >= 0) ::close(up);
+  ::close(down);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  // Static storage: detached workers may still reference the config in
+  // the instant between main returning and process teardown.
+  static Config cfg;
+  auto env = [](const char* k, const char* dflt) {
+    const char* v = std::getenv(k);
+    return std::string(v ? v : dflt);
+  };
+  cfg.sched_ip = env("SCHEDULER_IP", "127.0.0.1");
+  cfg.sched_port = std::atoi(env("SCHEDULER_PORT", "0").c_str());
+  cfg.port = std::atoi(env("KUBESHARE_TPU_POD_MANAGER_PORT", "0").c_str());
+  cfg.pod_name = env("KUBESHARE_TPU_POD_NAME", "");
+  cfg.request = std::atof(env("POD_REQUEST", "0").c_str());
+  cfg.limit = std::atof(env("POD_LIMIT", "0").c_str());
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string a = argv[i];
+    if (a == "--scheduler-ip") cfg.sched_ip = argv[i + 1];
+    else if (a == "--scheduler-port") cfg.sched_port = std::atoi(argv[i + 1]);
+    else if (a == "--port") cfg.port = std::atoi(argv[i + 1]);
+    else if (a == "--pod-name") cfg.pod_name = argv[i + 1];
+    else if (a == "--request") cfg.request = std::atof(argv[i + 1]);
+    else if (a == "--limit") cfg.limit = std::atof(argv[i + 1]);
+  }
+  if (cfg.sched_port <= 0 || cfg.pod_name.empty()) {
+    std::fprintf(stderr, "need --scheduler-port and --pod-name\n");
+    return 2;
+  }
+
+  // Register the pod's share on the scheduler (held for our lifetime —
+  // its drop on our exit is the launcher's kill path freeing the share).
+  int reg = dial(cfg.sched_ip, cfg.sched_port);
+  if (reg < 0) {
+    std::fprintf(stderr, "cannot reach scheduler\n");
+    return 1;
+  }
+  {
+    char buf[256];
+    std::snprintf(buf, sizeof(buf),
+                  "{\"op\": \"register\", \"name\": \"%s\", \"request\": "
+                  "%.6f, \"limit\": %.6f}",
+                  json_escape(cfg.pod_name).c_str(), cfg.request, cfg.limit);
+    std::string r;
+    if (!rpc(reg, buf, r) || json_str(r, "error").size()) {
+      std::fprintf(stderr, "register failed: %s\n", r.c_str());
+      return 1;
+    }
+  }
+
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(cfg.port));
+  ::inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (::bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(srv, 64) != 0) {
+    std::fprintf(stderr, "cannot bind port %d\n", cfg.port);
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::printf("READY %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  // sigaction WITHOUT SA_RESTART: the stop signal must interrupt the
+  // blocking accept() (glibc's signal() implies SA_RESTART, which would
+  // park us in accept forever).
+  struct sigaction sa {};
+  sa.sa_handler = [](int) { g_stop.store(true); };
+  ::sigaction(SIGTERM, &sa, nullptr);
+  ::sigaction(SIGINT, &sa, nullptr);
+  ::signal(SIGPIPE, SIG_IGN);
+
+  while (!g_stop.load()) {
+    int down = ::accept(srv, nullptr, nullptr);
+    if (down < 0) {
+      if (g_stop.load()) break;
+      if (errno != EINTR) ::usleep(50'000);  // EMFILE etc: no busy spin
+      continue;
+    }
+    ::setsockopt(down, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Detached: crash-release runs inside serve_conn itself, and a
+    // reconnecting workload must not accumulate unreaped threads.
+    std::thread(serve_conn, std::cref(cfg), down).detach();
+  }
+  // Unregister (frees the share) and exit; in-flight workers die with
+  // the process — their sessions are connection-scoped on the scheduler.
+  {
+    std::string r;
+    rpc(reg, with_name("{\"op\": \"unregister\"}", cfg.pod_name), r);
+  }
+  ::close(reg);
+  ::close(srv);
+  return 0;
+}

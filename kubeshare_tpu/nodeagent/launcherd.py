@@ -56,7 +56,9 @@ def default_proxy_cmd(chip_id: str, index: int, exec_port: int,
 def default_pmgr_cmd(name: str, port: int, request: float, limit: float,
                      token_port: int) -> tuple[list[str], dict]:
     """The real pod-manager command (gem-pmgr env contract,
-    ``launcher.py:41-56``)."""
+    ``launcher.py:41-56``): the native C++ relay when the toolchain can
+    build it (the reference's gem-pmgr is native), else the Python twin —
+    identical protocol behavior, tested against the same scheduler."""
     env = dict(os.environ)
     env.update({
         "SCHEDULER_IP": "127.0.0.1",
@@ -66,6 +68,10 @@ def default_pmgr_cmd(name: str, port: int, request: float, limit: float,
         "POD_REQUEST": str(request),
         "POD_LIMIT": str(limit),
     })
+    from ..isolation.native import build_binary
+    exe = build_binary("podmgr_relay")
+    if exe:
+        return [exe], env
     return [sys.executable, "-m", "kubeshare_tpu.isolation.podmgr"], env
 
 
@@ -89,6 +95,12 @@ class LauncherDaemon:
         self._mtimes: dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Warm the native pod-manager build once at daemon startup so the
+        # first pod's spawn (on the watcher thread) never blocks on g++;
+        # default_pmgr_cmd then only consumes the cached result.
+        if pmgr_cmd is default_pmgr_cmd:
+            from ..isolation.native import build_binary
+            build_binary("podmgr_relay")
 
     # -- process helpers ---------------------------------------------------
 

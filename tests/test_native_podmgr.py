@@ -1,0 +1,122 @@
+"""Native C++ pod-manager relay (podmgr_relay.cpp) — behavioral parity
+with the Python PodManager, against the same token scheduler."""
+
+import subprocess
+import time
+
+import pytest
+
+from kubeshare_tpu.isolation import protocol
+from kubeshare_tpu.isolation.client import ExecutionGate
+from kubeshare_tpu.isolation.native import build_binary
+from kubeshare_tpu.isolation.tokensched import TokenScheduler, serve
+
+WINDOW, BASE, MIN = 2000.0, 100.0, 10.0
+
+
+@pytest.fixture(scope="module")
+def relay_bin():
+    exe = build_binary("podmgr_relay")
+    if exe is None:
+        pytest.skip("no C++ toolchain")
+    return exe
+
+
+def start_relay(relay_bin, sched_port, name="ns/native", request=0.5,
+                limit=1.0):
+    proc = subprocess.Popen(
+        [relay_bin, "--scheduler-ip", "127.0.0.1",
+         "--scheduler-port", str(sched_port), "--port", "0",
+         "--pod-name", name, "--request", str(request),
+         "--limit", str(limit)],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), line
+    return proc, int(line.split()[1])
+
+
+def test_native_relay_registers_relays_unregisters(relay_bin):
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    proc, port = start_relay(relay_bin, srv.server_address[1])
+    try:
+        assert sched.core.client_count() == 1
+        with protocol.Connection("127.0.0.1", port) as conn:
+            reply, _ = conn.call({"op": "register", "name": "ignored"})
+            assert reply["name"] == "ns/native"
+            reply, _ = conn.call({"op": "acquire", "name": "x"})
+            assert reply["quota_ms"] == BASE
+            conn.call({"op": "release", "name": "x", "used_ms": 30.0})
+            reply, _ = conn.call({"op": "usage", "name": "x"})
+            assert reply["used_ms"] == pytest.approx(30.0, abs=5.0)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        deadline = time.monotonic() + 2.0
+        while sched.core.client_count() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.core.client_count() == 0  # unregistered on SIGTERM
+        srv.shutdown()
+
+
+def test_native_relay_gate_accounts_usage(relay_bin):
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    proc, port = start_relay(relay_bin, srv.server_address[1],
+                             name="ns/native-g")
+    try:
+        conn = protocol.Connection("127.0.0.1", port)
+        conn.call({"op": "register"})
+        gate = ExecutionGate(conn, "ns/native-g")
+        for _ in range(5):
+            gate()
+            time.sleep(0.03)
+        gate.close()
+        assert sched.window_usage("ns/native-g") == pytest.approx(
+            150.0, rel=0.5)
+        conn.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        srv.shutdown()
+
+
+def test_native_relay_crash_releases_token(relay_bin):
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    proc, port = start_relay(relay_bin, srv.server_address[1],
+                             name="ns/native-crash")
+    try:
+        conn = protocol.Connection("127.0.0.1", port)
+        reply, _ = conn.call({"op": "acquire", "name": "x"})
+        assert reply["quota_ms"] == BASE
+        assert sched.core.holder() == "ns/native-crash"
+        conn.close()  # crash: no release
+        deadline = time.monotonic() + 2.0
+        while sched.core.holder() is not None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.core.holder() is None
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        srv.shutdown()
+
+
+def test_native_relay_two_connections_no_deadlock(relay_bin):
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    proc, port = start_relay(relay_bin, srv.server_address[1],
+                             name="ns/native-m")
+    try:
+        c1 = protocol.Connection("127.0.0.1", port)
+        c2 = protocol.Connection("127.0.0.1", port)
+        c1.call({"op": "acquire"})
+        reply, _ = c2.call({"op": "usage"})  # must not block behind c1
+        assert reply["ok"] is True
+        c1.call({"op": "release", "used_ms": 5.0})
+        c1.close()
+        c2.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        srv.shutdown()
