@@ -7,11 +7,16 @@ PY ?= python
 
 all: native
 
-native: kubeshare_tpu/isolation/native/_build/libtokensched.so
+native: kubeshare_tpu/isolation/native/_build/libtokensched.so \
+        kubeshare_tpu/isolation/native/_build/podmgr_relay
 
 kubeshare_tpu/isolation/native/_build/libtokensched.so: kubeshare_tpu/isolation/native/tokensched.cpp
 	mkdir -p $(dir $@)
 	g++ -O2 -shared -fPIC -std=c++17 $< -o $@
+
+kubeshare_tpu/isolation/native/_build/podmgr_relay: kubeshare_tpu/isolation/native/podmgr_relay.cpp
+	mkdir -p $(dir $@)
+	g++ -O2 -pthread -std=c++17 $< -o $@
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -23,4 +28,4 @@ image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .
 
 clean:
-	rm -f kubeshare_tpu/isolation/native/_build/libtokensched.so
+	rm -rf kubeshare_tpu/isolation/native/_build

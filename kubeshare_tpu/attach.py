@@ -344,13 +344,39 @@ def attach_gate(host: str, port: int, name: str, request: float,
         log.info("attached (gate mode) to %s:%d as %s", host, port, name)
 
 
+def _pin_visible_devices() -> bool:
+    """Translate the scheduler's chip grant (global chip ids, trailing
+    per-host index — topology/chip.make_chip_id) into the local
+    TPU_VISIBLE_DEVICES the runtime understands: the
+    NVIDIA_VISIBLE_DEVICES equivalent, applied before jax initializes.
+    Runs for EVERY attach mode — a gate-mode pod on a multi-chip host
+    must not initialize chips granted to other pods."""
+    chips = os.environ.get(C.ENV_VISIBLE_CHIPS, "")
+    if not chips or os.environ.get("TPU_VISIBLE_DEVICES"):
+        return False
+    try:
+        indices = [str(int(c.rsplit("-", 1)[1]))
+                   for c in chips.split(",") if c]
+    except (IndexError, ValueError):
+        log.warning("cannot parse local indices from %s=%r",
+                    C.ENV_VISIBLE_CHIPS, chips)
+        return False
+    if not indices:
+        return False
+    os.environ["TPU_VISIBLE_DEVICES"] = ",".join(indices)
+    return True
+
+
 def attach_if_env() -> str:
     """Entry point for the sitecustomize shim: attach according to the
     injected env (no-op without it). Returns the mode activated
-    ("proxy" | "gate" | "")."""
+    ("proxy" | "gate" | "visible" | "") — "visible" meaning no metering
+    attached, but the granted chips were pinned via TPU_VISIBLE_DEVICES
+    (the whole-chip path)."""
     mode = os.environ.get(C.ENV_ATTACH_MODE, "").lower()
     if mode == "off" or _active is not None:
         return ""
+    pinned = _pin_visible_devices()
     proxy_port = int(os.environ.get(C.ENV_CHIP_PROXY_PORT, "0") or 0)
     mgr_port = int(os.environ.get(C.ENV_POD_MANAGER_PORT, "0") or 0)
     if mode == "proxy" and not proxy_port:
@@ -377,7 +403,10 @@ def attach_if_env() -> str:
     if mgr_port and mode in ("", "gate"):
         attach_gate(host, mgr_port, name, request, limit)
         return "gate"
-    return ""
+    # Whole-chip pod (no manager port — the reference's multi-GPU path,
+    # pod.go:348-400): no metering to attach; the pin above is the whole
+    # contract.
+    return "visible" if pinned else ""
 
 
 def detach() -> None:

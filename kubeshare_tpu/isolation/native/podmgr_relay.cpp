@@ -237,9 +237,10 @@ void serve_conn(const Config& cfg, int down) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  // Static storage: detached workers may still reference the config in
-  // the instant between main returning and process teardown.
-  static Config cfg;
+  // Deliberately leaked: detached workers may still reference the config
+  // after main returns, and exit() would destroy a static's strings
+  // under them.
+  Config& cfg = *new Config;
   auto env = [](const char* k, const char* dflt) {
     const char* v = std::getenv(k);
     return std::string(v ? v : dflt);
@@ -319,8 +320,16 @@ int main(int argc, char** argv) {
     }
     ::setsockopt(down, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     // Detached: crash-release runs inside serve_conn itself, and a
-    // reconnecting workload must not accumulate unreaped threads.
+    // reconnecting workload must not accumulate unreaped threads. Stop
+    // signals are blocked across creation so the child can never inherit
+    // an unblocked mask (its own pthread_sigmask has a startup window).
+    sigset_t stopset, prev;
+    sigemptyset(&stopset);
+    sigaddset(&stopset, SIGTERM);
+    sigaddset(&stopset, SIGINT);
+    pthread_sigmask(SIG_BLOCK, &stopset, &prev);
     std::thread(serve_conn, std::cref(cfg), down).detach();
+    pthread_sigmask(SIG_SETMASK, &prev, nullptr);
   }
   // Unregister (frees the share) and exit; in-flight workers die with
   // the process — their sessions are connection-scoped on the scheduler.

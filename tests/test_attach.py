@@ -143,3 +143,47 @@ def test_unmodified_mnist_runs_through_proxy_subprocess(proxy):
     # the workload's executions landed on OUR proxy (2 warmup + 3 timed)
     assert proxy.total_execs >= 5
     assert "mnist-pod" not in proxy._sessions  # cleanly disconnected
+
+
+def test_whole_chip_pod_sets_visible_devices(monkeypatch):
+    """Whole-chip pods (no manager port) get their granted chips pinned
+    via TPU_VISIBLE_DEVICES, parsed from the chip ids' per-host index."""
+    from kubeshare_tpu import attach
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES", raising=False)
+    monkeypatch.setenv(C.ENV_VISIBLE_CHIPS,
+                       "TPU-v5e-host-a-2,TPU-v5e-host-a-3")
+    assert attach.attach_if_env() == "visible"
+    assert os.environ["TPU_VISIBLE_DEVICES"] == "2,3"
+
+
+def test_whole_chip_visible_devices_not_overridden(monkeypatch):
+    from kubeshare_tpu import attach
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0")
+    monkeypatch.setenv(C.ENV_VISIBLE_CHIPS, "TPU-v5e-host-a-2")
+    assert attach.attach_if_env() == ""
+    assert os.environ["TPU_VISIBLE_DEVICES"] == "0"
+
+
+def test_gate_mode_also_pins_visible_devices(monkeypatch):
+    """A gate-mode pod on a multi-chip host must be confined to its
+    granted chip — pinning runs for every attach mode, not only the
+    whole-chip fallthrough."""
+    from kubeshare_tpu import attach
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler, serve
+
+    sched = TokenScheduler(window_ms=500, base_quota_ms=30, min_quota_ms=5)
+    server = serve(sched)
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES", raising=False)
+    monkeypatch.setenv(C.ENV_VISIBLE_CHIPS, "TPU-v4-host-3")
+    monkeypatch.setenv(C.ENV_POD_MANAGER_PORT,
+                       str(server.server_address[1]))
+    monkeypatch.setenv(C.ENV_POD_NAME, "gated-pin")
+    monkeypatch.setenv(C.ENV_TPU_REQUEST, "0.5")
+    try:
+        assert attach.attach_if_env() == "gate"
+        assert os.environ["TPU_VISIBLE_DEVICES"] == "3"
+    finally:
+        attach.detach()
+        server.shutdown()
+        server.server_close()
+        sched.close()
