@@ -404,8 +404,13 @@ def attach_if_env() -> str:
         attach_gate(host, mgr_port, name, request, limit)
         return "gate"
     # Whole-chip pod (no manager port — the reference's multi-GPU path,
-    # pod.go:348-400): no metering to attach; the pin above is the whole
-    # contract.
+    # pod.go:348-400): no metering to attach; the pin above confines the
+    # process, and a gang member additionally joins its jax.distributed
+    # runtime here — zero-touch multi-host, driven by the scheduler's
+    # rank + the manifest's coordinator address (parallel/runner).
+    from .parallel.runner import distributed_init_from_env
+    if distributed_init_from_env():
+        return "distributed"
     return "visible" if pinned else ""
 
 

@@ -79,7 +79,8 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
                  optimizer: optax.GradientTransformation | None = None,
                  checkpoint: str = "",
                  checkpoint_every: int = 0,
-                 profile_dir: str = "") -> TrainResult:
+                 profile_dir: str = "",
+                 mesh=None) -> TrainResult:
     """Train for ``steps`` timed steps on one fixed synthetic batch.
 
     ``warmup`` untimed steps absorb compile time; each timed step blocks on
@@ -93,13 +94,27 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
     continues the same trajectory — the restartable-filler-work premise
     of the opportunistic tier.
     """
+    if mesh is None and jax.process_count() > 1:
+        # Gang member (the attach shim already joined jax.distributed):
+        # train over the WHOLE gang's chips, not just the local ones.
+        from ..parallel.runner import gang_mesh
+        mesh = gang_mesh()
+
     key = jax.random.PRNGKey(seed)
     pkey, bkey = jax.random.split(key)
     params = init_fn(pkey)
     optimizer = optimizer or optax.adam(learning_rate)
     opt_state = optimizer.init(params)
-    step = make_train_step(loss_fn, optimizer)
     batch = batch_fn(bkey)
+    if mesh is not None:
+        from ..parallel.mesh import (data_sharding, make_sharded_train_step,
+                                     param_sharding)
+        step = make_sharded_train_step(loss_fn, optimizer, mesh)
+        params = jax.device_put(params, param_sharding(mesh, params))
+        opt_state = optimizer.init(params)
+        batch = jax.device_put(batch, data_sharding(mesh))
+    else:
+        step = make_train_step(loss_fn, optimizer)
 
     done = 0
     if checkpoint:

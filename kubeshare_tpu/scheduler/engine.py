@@ -26,6 +26,7 @@ Extension-point parity map:
 from __future__ import annotations
 
 import math
+import re
 import time
 from dataclasses import dataclass, field
 
@@ -352,12 +353,14 @@ class SchedulerEngine:
         full_gang = (pod.group_name
                      and pod.min_available == pod.headcount)
         if full_gang and pod.group_rank < 0:
-            # Smallest free rank in the gang (freed on unreserve/delete):
-            # the distributed runner uses it as jax.distributed
-            # process_id, so it must be unique and dense in
-            # [0, headcount). All ranks held (e.g. a replacement arriving
-            # before the dead member's delete event) → unschedulable
-            # until a rank frees, never a duplicate or out-of-range id.
+            # Rank = jax.distributed process_id: unique and dense in
+            # [0, headcount), freed on unreserve/delete. The pod name's
+            # trailing ordinal is PREFERRED when free ("...-0" gets rank
+            # 0 regardless of scheduling order) so manifests can wire the
+            # coordinator address to the -0 member deterministically;
+            # otherwise smallest free. All ranks held (a replacement
+            # racing the dead member's delete event) → unschedulable
+            # until one frees, never a duplicate or out-of-range id.
             taken = {m.group_rank for m in self._group_members(pod)
                      if m.group_rank >= 0}
             free = [r for r in range(pod.headcount) if r not in taken]
@@ -365,7 +368,7 @@ class SchedulerEngine:
                 raise Unschedulable(
                     f"{pod.key}: all {pod.headcount} ranks of gang "
                     f"{pod.group_name} are held; delete a member first")
-            pod.group_rank = free[0]
+            pod.group_rank = self._preferred_rank(pod, free)
         group_kw = dict(group=pod.group_name, group_size=pod.headcount,
                         group_rank=pod.group_rank) if pod.group_name else {}
         if not pod.needs_tpu:
@@ -420,6 +423,29 @@ class SchedulerEngine:
         return Binding(pod.key, node_name, pod.chip_ids, [cell.id],
                        [cell.cell_type], pod.memory, pod.port,
                        request=pod.request, limit=pod.limit, **group_kw)
+
+    def _preferred_rank(self, pod: PodRequest, free: list[int]) -> int:
+        """Name-ordinal rank, applied ALL-or-nothing: only when every gang
+        member's name carries a distinct trailing ordinal covering exactly
+        [0, headcount) (the StatefulSet convention) does "...-0" get rank
+        0 — a half-applied preference could land process_id 0 on a pod
+        other than the one the manifest wired as coordinator. Otherwise
+        smallest free, with a log line so the mismatch is diagnosable."""
+        ordinals = {}
+        for m in self._group_members(pod):
+            match = re.search(r"(\d+)$", m.name)
+            ordinals[m.key] = int(match.group(1)) if match else -1
+        clean = (len(ordinals) == pod.headcount
+                 and sorted(ordinals.values()) == list(range(pod.headcount)))
+        if clean and ordinals[pod.key] in free:
+            return ordinals[pod.key]
+        if not clean:
+            log.info("gang %s: member names are not dense 0-indexed "
+                     "ordinals (%s); assigning ranks by arrival — wire "
+                     "the coordinator address to the rank-0 annotation, "
+                     "not a fixed pod name", pod.group_name,
+                     sorted(ordinals.values()))
+        return free[0]
 
     def unreserve(self, pod: PodRequest) -> list[str]:
         """Roll back a reservation; returns group members that should be

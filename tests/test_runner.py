@@ -52,26 +52,30 @@ print("RESULT", float(total), flush=True)
 
 
 def test_two_process_gang_rendezvous_and_mesh():
-    port = free_port()
-    procs = []
-    for rank in range(2):
-        env = dict(
-            os.environ,
-            PYTHONPATH=str(REPO),
-            **{
-                C.ENV_COORDINATOR: f"127.0.0.1:{port}",
-                C.ENV_NUM_PROCESSES: "2",
-                C.ENV_PROCESS_ID: str(rank),
-                C.ENV_GROUP_NAME: "testgang",
-            },
-        )
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", GANG_PROG], env=env, cwd=str(REPO),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    for p in procs:
-        out, _ = p.communicate(timeout=180)
-        assert p.returncode == 0, out[-2000:]
-        assert "RESULT 28.0" in out, out[-2000:]
+    last = None
+    for _attempt in range(2):  # a freed port can be re-grabbed: retry once
+        port = free_port()
+        procs = []
+        for rank in range(2):
+            env = dict(
+                os.environ,
+                PYTHONPATH=str(REPO),
+                **{
+                    C.ENV_COORDINATOR: f"127.0.0.1:{port}",
+                    C.ENV_NUM_PROCESSES: "2",
+                    C.ENV_PROCESS_ID: str(rank),
+                    C.ENV_GROUP_NAME: "testgang",
+                },
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", GANG_PROG], env=env, cwd=str(REPO),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+        if all(p.returncode == 0 for p in procs) and all(
+                "RESULT 28.0" in o for o in outs):
+            return
+        last = [o[-2000:] for o in outs]
+    raise AssertionError(last)
 
 
 def test_engine_assigns_dense_unique_gang_ranks():
@@ -189,3 +193,67 @@ def test_resync_restores_gang_rank():
     # A replacement in the restarted engine cannot steal a live rank.
     taken = {p.group_rank for p in fresh.pod_status.values()}
     assert taken == {0, 1}
+
+
+def test_engine_prefers_pod_name_ordinal_as_rank():
+    """'...-0' gets rank 0 even when scheduled LAST — manifests pin the
+    jax.distributed coordinator to the -0 member's DNS name."""
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    eng = SchedulerEngine()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=1, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    labels = {
+        C.POD_TPU_REQUEST: "1.0",
+        C.POD_TPU_LIMIT: "1.0",
+        C.POD_GROUP_NAME: "tg",
+        C.POD_GROUP_HEADCOUNT: "3",
+        C.POD_GROUP_THRESHOLD: "1",
+    }
+    pods = {n: eng.submit("ns", n, dict(labels), uid=n)
+            for n in ("tg-0", "tg-1", "tg-2")}
+    # schedule out of order: 2, 0, 1
+    ranks = {n: eng.schedule(pods[n]).group_rank
+             for n in ("tg-2", "tg-0", "tg-1")}
+    assert ranks == {"tg-0": 0, "tg-1": 1, "tg-2": 2}
+
+
+GANG_CLI = None  # the real model CLI, attached via env only
+
+
+def test_two_process_gang_trains_one_model_zero_touch():
+    """The manifest contract end-to-end: two UNMODIFIED model CLI
+    processes + gang env (+ shim on PYTHONPATH) join one jax.distributed
+    runtime and train ONE data-parallel model — identical losses."""
+    port = free_port()
+    shim = REPO / "kubeshare_tpu" / "_shim"
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            PYTHONPATH=os.pathsep.join([str(shim), str(REPO)]),
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            **{
+                C.ENV_COORDINATOR: f"127.0.0.1:{port}",
+                C.ENV_NUM_PROCESSES: "2",
+                C.ENV_PROCESS_ID: str(rank),
+                C.ENV_GROUP_NAME: "cli-gang",
+            },
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubeshare_tpu.models.mnist",
+             "--steps", "2", "--platform", "cpu"],
+            env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, out[-2000:]
+        outs.append(out)
+    losses = [l.split("final loss")[-1].strip()
+              for out in outs for l in out.splitlines() if "final loss" in l]
+    assert len(losses) == 2 and losses[0] == losses[1], losses
