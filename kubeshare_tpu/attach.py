@@ -402,16 +402,35 @@ def attach_if_env() -> str:
         return "proxy"
     if mgr_port and mode in ("", "gate"):
         attach_gate(host, mgr_port, name, request, limit)
+        # Gate-mode pods own their device, so a fractional full gang can
+        # still train one SPMD model across hosts (metered by tokens).
+        _join_gang_or_die()
         return "gate"
     # Whole-chip pod (no manager port — the reference's multi-GPU path,
     # pod.go:348-400): no metering to attach; the pin above confines the
     # process, and a gang member additionally joins its jax.distributed
-    # runtime here — zero-touch multi-host, driven by the scheduler's
-    # rank + the manifest's coordinator address (parallel/runner).
-    from .parallel.runner import distributed_init_from_env
-    if distributed_init_from_env():
+    # runtime — zero-touch multi-host, driven by the scheduler's rank +
+    # the manifest's coordinator address (parallel/runner). Proxy mode
+    # deliberately does NOT join: its executions are forwarded to the
+    # chip proxy, which owns the device — there is no local mesh to rank.
+    if _join_gang_or_die():
         return "distributed"
     return "visible" if pinned else ""
+
+
+def _join_gang_or_die() -> bool:
+    """Join jax.distributed when the gang env is present. A member whose
+    rendezvous FAILS must terminate rather than silently train solo — the
+    rest of the gang is blocked waiting for its rank, and only a restart
+    retries the rendezvous. SystemExit passes through the shim's
+    never-break-the-interpreter Exception guard by design."""
+    from .parallel.runner import distributed_init_from_env
+    try:
+        return distributed_init_from_env()
+    except Exception as exc:
+        log.error("gang member failed jax.distributed rendezvous: %s — "
+                  "exiting so the restart can retry", exc)
+        raise SystemExit(1) from exc
 
 
 def detach() -> None:

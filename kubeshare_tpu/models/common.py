@@ -100,21 +100,28 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
         from ..parallel.runner import gang_mesh
         mesh = gang_mesh()
 
+    if mesh is not None and checkpoint:
+        # Orbax multihost save needs one SHARED directory + barrier'd
+        # commit; a pod-local path would persist only the local shards.
+        # Refuse loudly rather than write an unrestorable checkpoint.
+        raise ValueError("checkpointing is not supported in multi-process "
+                         "gang runs yet — drop --checkpoint or train "
+                         "single-process")
+
     key = jax.random.PRNGKey(seed)
     pkey, bkey = jax.random.split(key)
     params = init_fn(pkey)
     optimizer = optimizer or optax.adam(learning_rate)
-    opt_state = optimizer.init(params)
     batch = batch_fn(bkey)
     if mesh is not None:
         from ..parallel.mesh import (data_sharding, make_sharded_train_step,
                                      param_sharding)
         step = make_sharded_train_step(loss_fn, optimizer, mesh)
         params = jax.device_put(params, param_sharding(mesh, params))
-        opt_state = optimizer.init(params)
         batch = jax.device_put(batch, data_sharding(mesh))
     else:
         step = make_train_step(loss_fn, optimizer)
+    opt_state = optimizer.init(params)
 
     done = 0
     if checkpoint:

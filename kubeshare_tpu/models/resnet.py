@@ -43,15 +43,19 @@ def _block_apply(params: dict, x: jax.Array, stride: int) -> jax.Array:
     return jax.nn.relu(y + x.astype(y.dtype))
 
 
-def init(key) -> dict:
-    n_blocks = len(STAGES) * BLOCKS_PER_STAGE
+def init(key, *, blocks_per_stage: tuple = None) -> dict:
+    """``blocks_per_stage`` defaults to the resnet18-class (2,2,2,2);
+    pass ``RESNET50_BLOCKS`` (3,4,6,3) for the resnet50-class depth the
+    reference's distribute jobs use."""
+    bps = blocks_per_stage or (BLOCKS_PER_STAGE,) * len(STAGES)
+    n_blocks = sum(bps)
     keys = jax.random.split(key, n_blocks + 2)
     params: dict = {"stem": conv2d_init(keys[0], 3, STAGES[0]),
                     "stem_bn": batchnorm_init(STAGES[0])}
     in_ch = STAGES[0]
     ki = 1
     for s, ch in enumerate(STAGES):
-        for b in range(BLOCKS_PER_STAGE):
+        for b in range(bps[s]):
             params[f"s{s}b{b}"] = _block_init(keys[ki], in_ch, ch)
             in_ch = ch
             ki += 1
@@ -59,11 +63,22 @@ def init(key) -> dict:
     return params
 
 
+RESNET50_BLOCKS = (3, 4, 6, 3)
+
+
+def init50(key) -> dict:
+    return init(key, blocks_per_stage=RESNET50_BLOCKS)
+
+
 def apply(params: dict, x: jax.Array) -> jax.Array:
+    import itertools
+
     x = conv2d_apply(params["stem"], x, dtype=DTYPE)
     x = jax.nn.relu(batchnorm_apply(params["stem_bn"], x.astype(jnp.float32)))
     for s in range(len(STAGES)):
-        for b in range(BLOCKS_PER_STAGE):
+        for b in itertools.count():             # walk whatever depth exists
+            if f"s{s}b{b}" not in params:
+                break
             stride = 2 if (s > 0 and b == 0) else 1
             x = _block_apply(params[f"s{s}b{b}"], x, stride)
     x = jnp.mean(x, axis=(1, 2))  # global average pool

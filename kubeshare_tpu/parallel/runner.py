@@ -50,9 +50,16 @@ def distributed_init_from_env(env: dict | None = None) -> bool:
     if _initialized:
         return True
     import jax
+    kwargs = {}
+    timeout_s = env.get("KUBESHARE_TPU_RENDEZVOUS_TIMEOUT_S", "")
+    if timeout_s:
+        # Bound the wait for a missing coordinator; on expiry initialize
+        # raises and the attach shim exits the member so a restart
+        # retries (instead of blocking jax's multi-minute default).
+        kwargs["initialization_timeout"] = int(timeout_s)
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=int(nproc),
-                               process_id=int(rank))
+                               process_id=int(rank), **kwargs)
     _initialized = True
     log.info("joined gang %s as process %s/%s via %s",
              env.get(C.ENV_GROUP_NAME, "?"), rank, nproc, coord)

@@ -445,6 +445,15 @@ class SchedulerEngine:
                      "the coordinator address to the rank-0 annotation, "
                      "not a fixed pod name", pod.group_name,
                      sorted(ordinals.values()))
+        else:
+            # Clean names but this pod's ordinal is held (e.g. ranks
+            # restored from a pre-ordinal resync): the coordinator may
+            # not live on the '-0' pod — say so, it is the one mismatch
+            # a name-wired manifest cannot survive silently.
+            log.warning("gang %s: %s's name-ordinal %d is already held; "
+                        "assigning %d — coordinator wiring by pod name "
+                        "may not match rank 0", pod.group_name, pod.name,
+                        ordinals[pod.key], free[0])
         return free[0]
 
     def unreserve(self, pod: PodRequest) -> list[str]:

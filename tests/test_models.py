@@ -138,3 +138,21 @@ def test_cli_resume_skips_done_steps(tmp_path):
     out2 = subprocess.run(cmd, capture_output=True, text=True, cwd=repo,
                           check=True)
     assert "0 steps" in out2.stdout   # all done: nothing left to run
+
+
+def test_resnet50_class_depth():
+    """The resnet50-class depth (3,4,6,3 — the reference's distribute
+    jobs) shares apply/loss with the default resnet18-class config."""
+    import re
+
+    import jax
+    import numpy as np
+
+    from kubeshare_tpu.models import resnet
+
+    params = resnet.init50(jax.random.PRNGKey(0))
+    blocks = [k for k in params if re.fullmatch(r"s\db\d", k)]
+    assert len(blocks) == 16  # 3+4+6+3
+    x, y = resnet.batch_fn(jax.random.PRNGKey(1))
+    loss = resnet.loss_fn(params, (x[:4], y[:4]))
+    assert np.isfinite(float(loss))

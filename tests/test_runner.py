@@ -70,7 +70,15 @@ def test_two_process_gang_rendezvous_and_mesh():
             procs.append(subprocess.Popen(
                 [sys.executable, "-c", GANG_PROG], env=env, cwd=str(REPO),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        outs = [p.communicate(timeout=180)[0] for p in procs]
+        try:
+            outs = [p.communicate(timeout=180)[0] for p in procs]
+        except subprocess.TimeoutExpired:
+            # a hung rendezvous (port stolen) — kill and retry
+            for p in procs:
+                p.kill()
+            outs = [p.communicate()[0] or "" for p in procs]
+            last = ["TIMEOUT"] + [o[-500:] for o in outs]
+            continue
         if all(p.returncode == 0 for p in procs) and all(
                 "RESULT 28.0" in o for o in outs):
             return
@@ -220,9 +228,6 @@ def test_engine_prefers_pod_name_ordinal_as_rank():
     ranks = {n: eng.schedule(pods[n]).group_rank
              for n in ("tg-2", "tg-0", "tg-1")}
     assert ranks == {"tg-0": 0, "tg-1": 1, "tg-2": 2}
-
-
-GANG_CLI = None  # the real model CLI, attached via env only
 
 
 def test_two_process_gang_trains_one_model_zero_touch():
