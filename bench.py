@@ -23,9 +23,13 @@ the 0.5 request. The co-located phase must span ≥ 3 accounting windows
 proxy's token-gated device-time accounting (``exec_ms_total``), which
 excludes token wait and compile time.
 
-On ANY failure (e.g. the TPU backend refusing to initialize — the exact
-mode that produced BENCH_r02's rc=1 traceback) a one-line diagnostic JSON
-with an ``"error"`` key is printed so the round still yields signal.
+When the chip is UNREACHABLE (the axon tunnel wedges for hours), the
+bench falls back to the CPU backend: the isolation runtime is
+backend-agnostic, so the co-location ratio and share fairness are still
+real framework measurements — reported with rc 0, ``"platform":
+"cpu-fallback"`` and the chip failure under ``"tpu_error"``. Only when
+even the fallback cannot run does the bench print a one-line diagnostic
+JSON with an ``"error"`` key and exit 1 (BENCH_r02's rc=1 traceback mode).
 """
 
 from __future__ import annotations
@@ -201,7 +205,8 @@ def _proxied_trainer(proxy_port: int, name: str, request: float, limit: float,
 
 
 def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
-              settle_s: float | None = None) -> dict:
+              settle_s: float | None = None,
+              exclusive_fused: bool | None = None) -> dict:
     import jax
 
     from kubeshare_tpu.constants import WINDOW_MS
@@ -211,12 +216,16 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
     platform = jax.devices()[0].platform
 
     exclusive_plain = _exclusive_steps_per_sec(exclusive_s)
-    # The fused baseline costs an extra XLA compile (minutes on the CPU
-    # test backend) — only worth paying on a real measurement run.
-    exclusive_fused = (_exclusive_steps_per_sec(exclusive_s,
-                                                fused_chunk=chunk)
-                       if exclusive_s >= 2.0 else 0.0)
-    exclusive_sps = max(exclusive_plain, exclusive_fused)
+    # The fused baseline costs an extra XLA compile (tens of seconds on
+    # the CPU test backend) — auto-skipped only for toy-duration runs;
+    # any run whose ratio is REPORTED must pay it, or the co-located
+    # side's dispatch amortization inflates the ratio.
+    if exclusive_fused is None:
+        exclusive_fused = exclusive_s >= 2.0
+    exclusive_fused_sps = (_exclusive_steps_per_sec(exclusive_s,
+                                                    fused_chunk=chunk)
+                           if exclusive_fused else 0.0)
+    exclusive_sps = max(exclusive_plain, exclusive_fused_sps)
     if settle_s is None:
         # Skip the startup transient, but never settle longer than we
         # measure (toy-duration test runs).
@@ -259,7 +268,7 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
         "vs_baseline": round(ratio / 0.90, 4),
         "exclusive_steps_per_sec": round(exclusive_sps, 2),
         "exclusive_plain_steps_per_sec": round(exclusive_plain, 2),
-        "exclusive_fused_steps_per_sec": round(exclusive_fused, 2),
+        "exclusive_fused_steps_per_sec": round(exclusive_fused_sps, 2),
         "colocated_aggregate_steps_per_sec": round(aggregate_sps, 2),
         "client_steps_per_sec": [round(a["steps_per_sec"], 2),
                                  round(b["steps_per_sec"], 2)],
@@ -326,10 +335,32 @@ def main(argv=None) -> int:
 
     err = _probe_backend(args.probe_timeout)
     if err is not None:
-        print(json.dumps({"metric": "colocated_2x0.5_aggregate_ratio",
-                          "value": 0.0, "unit": "fraction",
-                          "vs_baseline": 0.0, "error": err}))
-        return 1
+        # The chip is unreachable (the axon tunnel wedges for hours at a
+        # time) — fall back to the CPU backend: the isolation runtime is
+        # backend-agnostic, so the co-location ratio and share fairness
+        # are still REAL measurements of the framework, honestly labeled
+        # platform=cpu with the chip error attached. CPU steps are ~200ms,
+        # so a small fused chunk suffices and the settle phase shrinks.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            # Small knobs: CPU XLA compiles of the fused-loop buckets are
+            # tens of seconds each, and exclusive < 2.0 skips the fused
+            # exclusive baseline's extra compile — the whole fallback must
+            # fit the parent's watchdog budget alongside the probe time.
+            result = run_bench(1.5, min(args.colocated_seconds, 10.0),
+                               chunk=10, exclusive_fused=True)
+            result["platform"] = "cpu-fallback"
+            result["tpu_error"] = err
+            print(json.dumps(result))
+            return 0
+        except Exception as exc:
+            print(json.dumps({"metric": "colocated_2x0.5_aggregate_ratio",
+                              "value": 0.0, "unit": "fraction",
+                              "vs_baseline": 0.0,
+                              "error": f"{err}; cpu fallback failed: "
+                                       f"{type(exc).__name__}: {exc}"}))
+            return 1
 
     try:
         result = run_bench(args.exclusive_seconds, args.colocated_seconds,

@@ -63,6 +63,7 @@ ENV_GROUP_NAME = "KUBESHARE_TPU_GROUP"
 ENV_NUM_PROCESSES = "KUBESHARE_TPU_NUM_PROCESSES"
 ENV_PROCESS_ID = "KUBESHARE_TPU_PROCESS_ID"
 ENV_COORDINATOR = "KUBESHARE_TPU_COORDINATOR"
+ENV_RENDEZVOUS_TIMEOUT_S = "KUBESHARE_TPU_RENDEZVOUS_TIMEOUT_S"
 
 # Library/host paths (pod.go:23-26, cmd/kubeshare-query-ip/main.go:22-34).
 LIBRARY_PATH = "/var/lib/kubeshare-tpu/library"

@@ -100,10 +100,12 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
         from ..parallel.runner import gang_mesh
         mesh = gang_mesh()
 
-    if mesh is not None and checkpoint:
+    if checkpoint and jax.process_count() > 1:
         # Orbax multihost save needs one SHARED directory + barrier'd
         # commit; a pod-local path would persist only the local shards.
         # Refuse loudly rather than write an unrestorable checkpoint.
+        # (Single-process sharded runs checkpoint fine — every shard is
+        # process-addressable.)
         raise ValueError("checkpointing is not supported in multi-process "
                          "gang runs yet — drop --checkpoint or train "
                          "single-process")

@@ -51,12 +51,18 @@ def distributed_init_from_env(env: dict | None = None) -> bool:
         return True
     import jax
     kwargs = {}
-    timeout_s = env.get("KUBESHARE_TPU_RENDEZVOUS_TIMEOUT_S", "")
+    timeout_s = env.get(C.ENV_RENDEZVOUS_TIMEOUT_S, "")
     if timeout_s:
         # Bound the wait for a missing coordinator; on expiry initialize
         # raises and the attach shim exits the member so a restart
-        # retries (instead of blocking jax's multi-minute default).
-        kwargs["initialization_timeout"] = int(timeout_s)
+        # retries (instead of blocking jax's multi-minute default). A
+        # malformed value is a config typo, not a rendezvous failure —
+        # warn and use the default rather than crash-loop the pod.
+        try:
+            kwargs["initialization_timeout"] = int(float(timeout_s))
+        except ValueError:
+            log.warning("ignoring malformed %s=%r",
+                        C.ENV_RENDEZVOUS_TIMEOUT_S, timeout_s)
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=int(nproc),
                                process_id=int(rank), **kwargs)
