@@ -274,3 +274,46 @@ def test_gang_replay_restores_group(disp, clock):
     pod = eng2.pod_status["ns/g-0"]
     assert pod.group_name == "g" and pod.min_available == 2
     assert d2.outcome("ns/g-0").status == "bound"
+
+
+def test_node_health_flip_steers_and_recovers(clock):
+    """Failure-detection parity (§5 aux, node.go:95-254): an unhealthy
+    node's cells leave filtering while its bookings stay; pending pods
+    land on healthy nodes, and recovery makes the node schedulable
+    again."""
+    eng = make_engine(hosts=2, mesh=(2,), clock=clock)
+    disp = Dispatcher(eng, TelemetryRegistry(), clock=clock,
+                      retry_backoff_s=1.0)
+    a = disp.submit("ns", "a", shared("1", "1"))
+    disp.step()
+    first_node = disp.outcome(a).binding.node
+
+    # the node that took pod a fails; its booking must survive
+    eng.set_node_health(first_node, False)
+    booked = [c for c in eng.leaf_cells.values()
+              if c.chip_id in disp.outcome(a).binding.chip_ids]
+    assert len(booked) == len(disp.outcome(a).binding.chip_ids)
+    assert all(c.available == 0.0 for c in booked)
+
+    # new pods steer to the healthy node only
+    others = [disp.submit("ns", f"b{i}", shared("1", "1"))
+              for i in range(2)]
+    disp.step()
+    nodes = {disp.outcome(k).binding.node for k in others
+             if disp.outcome(k) and disp.outcome(k).status == "bound"}
+    assert nodes and first_node not in nodes
+
+    # the healthy node is now full; one more pod must WAIT (not land on
+    # the unhealthy node)
+    c = disp.submit("ns", "c", shared("1", "1"))
+    disp.step()
+    assert disp.outcome(c) is None
+
+    # recovery: pod a is deleted, node healed → c binds there
+    disp.delete(a)
+    eng.set_node_health(first_node, True)
+    clock.t += 2.0   # past the retry backoff
+    disp.step()
+    out = disp.outcome(c)
+    assert out is not None and out.status == "bound"
+    assert out.binding.node == first_node
