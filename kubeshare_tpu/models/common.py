@@ -80,7 +80,7 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
                  checkpoint: str = "",
                  checkpoint_every: int = 0,
                  profile_dir: str = "",
-                 mesh=None) -> TrainResult:
+                 mesh=None, mesh_hooks: dict | None = None) -> TrainResult:
     """Train for ``steps`` timed steps on one fixed synthetic batch.
 
     ``warmup`` untimed steps absorb compile time; each timed step blocks on
@@ -118,9 +118,18 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
     if mesh is not None:
         from ..parallel.mesh import (data_sharding, make_sharded_train_step,
                                      param_sharding)
-        step = make_sharded_train_step(loss_fn, optimizer, mesh)
+        # Model-provided mesh hooks (``mesh_hooks``): "loss" swaps in a
+        # mesh-aware loss (e.g. the transformer's ring attention over an
+        # sp axis) and "batch_sharding" the batch layout (token batches
+        # split their sequence axis too). Defaults serve every model.
+        hooks = mesh_hooks or {}
+        if "loss" in hooks:
+            loss_fn = hooks["loss"](mesh) or loss_fn
+        batch_sharding = (hooks.get("batch_sharding") or data_sharding)(mesh)
+        step = make_sharded_train_step(loss_fn, optimizer, mesh,
+                                       batch_sharding=batch_sharding)
         params = jax.device_put(params, param_sharding(mesh, params))
-        batch = jax.device_put(batch, data_sharding(mesh))
+        batch = jax.device_put(batch, batch_sharding)
     else:
         step = make_train_step(loss_fn, optimizer)
     opt_state = optimizer.init(params)
@@ -165,7 +174,8 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
                        final_loss=float(loss))
 
 
-def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None) -> TrainResult:
+def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None,
+             mesh_hooks: dict | None = None) -> TrainResult:
     """Shared ``python -m kubeshare_tpu.models.<name> --steps N`` entry."""
     import argparse
 
@@ -194,7 +204,8 @@ def main_cli(model_name: str, init_fn, loss_fn, batch_fn, argv=None) -> TrainRes
                           learning_rate=args.lr, seed=args.seed,
                           checkpoint=args.checkpoint,
                           checkpoint_every=args.checkpoint_every,
-                          profile_dir=args.profile)
+                          profile_dir=args.profile,
+                          mesh_hooks=mesh_hooks)
     print(f"{model_name}: {result.steps} steps in {result.seconds:.2f}s "
           f"= {result.steps_per_sec:.2f} steps/s, final loss {result.final_loss:.4f}")
     return result

@@ -109,5 +109,26 @@ batch_fn = partial(synthetic_token_batch, batch_size=BATCH_SIZE,
                    seq_len=SEQ_LEN, vocab=VOCAB)
 
 
+def _loss_for_mesh(mesh):
+    """Sequence-parallel loss when the gang's mesh carries an ``sp``
+    axis (e.g. ``KUBESHARE_TPU_MESH="dp=2,sp=2,tp=2"``): ring attention
+    over the sequence ring, dense otherwise (None = keep the default)."""
+    if "sp" not in mesh.axis_names:
+        return None
+    from ..parallel.ringattention import make_ring_attention
+    ring = make_ring_attention(mesh)
+    return partial(loss_fn, attn_fn=ring)
+
+
+def _token_sharding_hook(mesh):
+    from ..parallel.mesh import token_sharding
+    return token_sharding(mesh)
+
+
+MESH_HOOKS = {"loss": _loss_for_mesh,
+              "batch_sharding": _token_sharding_hook}
+
+
 if __name__ == "__main__":
-    main_cli("transformer", init, loss_fn, batch_fn)
+    main_cli("transformer", init, loss_fn, batch_fn,
+             mesh_hooks=MESH_HOOKS)

@@ -91,20 +91,26 @@ def param_sharding(mesh: Mesh, params):
     return jax.tree_util.tree_map(shard_leaf, params)
 
 
-def make_sharded_train_step(loss_fn: Callable, optimizer, mesh: Mesh):
+def make_sharded_train_step(loss_fn: Callable, optimizer, mesh: Mesh,
+                            batch_sharding: NamedSharding | None = None):
     """Jit a train step that *enforces* the mesh layout: the batch is
-    constrained to :func:`data_sharding` and params to
-    :func:`param_sharding` on the way in and out, so the layout holds even
-    for host-resident inputs. XLA inserts the psum for dp gradient
-    reduction and the tp collectives from the shardings. One step body with
-    the single-chip path (``models.common.make_train_step``)."""
+    constrained to ``batch_sharding`` (default :func:`data_sharding`;
+    pass :func:`token_sharding`'s result for sequence-split token
+    batches) and params to :func:`param_sharding` on the way in and out,
+    so the layout holds even for host-resident inputs. XLA inserts the
+    psum for dp gradient reduction and the tp collectives from the
+    shardings. One step body with the single-chip path
+    (``models.common.make_train_step``)."""
     from ..models.common import make_train_step
+
+    if batch_sharding is None:
+        batch_sharding = data_sharding(mesh)
 
     def constrain_params(params):
         return jax.lax.with_sharding_constraint(params, param_sharding(mesh, params))
 
     def constrain_batch(batch):
-        return jax.lax.with_sharding_constraint(batch, data_sharding(mesh))
+        return jax.lax.with_sharding_constraint(batch, batch_sharding)
 
     return make_train_step(loss_fn, optimizer,
                            constrain_params=constrain_params,

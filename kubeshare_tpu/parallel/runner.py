@@ -23,6 +23,7 @@ rendezvous with virtual devices.
 
 from __future__ import annotations
 
+import math
 import os
 
 from .. import constants as C
@@ -76,19 +77,60 @@ def gang_mesh(dp: int | None = None, tp: int | None = None,
               hybrid: bool | None = None):
     """Mesh over every device the gang sees (global across processes).
 
-    ``hybrid=None`` auto-selects: a two-tier ``(dcn, dp, tp)`` mesh when
-    the gang spans multiple ICI slices (distinct device ``slice_index``),
-    else a flat ``(dp, tp)`` mesh — a single slice's ICI spans hosts, so
-    multi-process alone does not warrant a DCN tier. ``hybrid=True``
-    forces the two-tier layout, grouping by slice when slices differ and
-    by process otherwise (hosts linked only by plain network — the
-    CPU-simulation case, and clusters without inter-host ICI).
+    ``KUBESHARE_TPU_MESH`` (e.g. ``"dp=2,sp=2,tp=2"``) overrides
+    everything: the manifest names the axes and sizes, the runner builds
+    exactly that mesh — the hook long-context workloads use to get an
+    ``sp`` axis for ring attention without touching code.
+
+    Otherwise ``hybrid=None`` auto-selects: a two-tier ``(dcn, dp, tp)``
+    mesh when the gang spans multiple ICI slices (distinct device
+    ``slice_index``), else a flat ``(dp, tp)`` mesh — a single slice's
+    ICI spans hosts, so multi-process alone does not warrant a DCN tier.
+    ``hybrid=True`` forces the two-tier layout, grouping by slice when
+    slices differ and by process otherwise (hosts linked only by plain
+    network — the CPU-simulation case, and clusters without inter-host
+    ICI).
     """
     import jax
 
     from .mesh import make_hybrid_mesh, make_mesh
 
     devices = jax.devices()
+
+    spec = os.environ.get("KUBESHARE_TPU_MESH", "")
+    if spec:
+        import numpy as np
+        from jax.sharding import Mesh
+        if dp is not None or tp is not None or hybrid is not None:
+            raise ValueError(
+                "gang_mesh received explicit dp/tp/hybrid arguments but "
+                f"KUBESHARE_TPU_MESH={spec!r} is set — remove one; the "
+                "env override would silently win otherwise")
+        axes = []
+        for part in spec.split(","):
+            name, _, size = part.partition("=")
+            try:
+                axes.append((name.strip(), int(size)))
+            except ValueError:
+                raise ValueError(f"bad KUBESHARE_TPU_MESH entry {part!r} "
+                                 "(want name=int)") from None
+        names = [n for n, _ in axes]
+        # The sharding helpers (param_sharding/data_sharding/
+        # make_sharded_train_step) require dp and tp axes; reject here
+        # with a clear message instead of a KeyError deep inside the
+        # jitted step. Axes you don't want simply get size 1.
+        for required in ("dp", "tp"):
+            if required not in names:
+                raise ValueError(
+                    f"KUBESHARE_TPU_MESH {spec!r} must name a {required!r} "
+                    f"axis (use {required}=1 to disable it)")
+        total = math.prod(s for _, s in axes)
+        if total != len(devices):
+            raise ValueError(
+                f"KUBESHARE_TPU_MESH {spec!r} wants {total} devices, gang "
+                f"has {len(devices)}")
+        return Mesh(np.array(devices).reshape([s for _, s in axes]),
+                    tuple(names))
 
     by_slice: dict = {}
     for d in devices:

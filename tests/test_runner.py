@@ -262,3 +262,38 @@ def test_two_process_gang_trains_one_model_zero_touch():
     losses = [l.split("final loss")[-1].strip()
               for out in outs for l in out.splitlines() if "final loss" in l]
     assert len(losses) == 2 and losses[0] == losses[1], losses
+
+
+def test_gang_cli_long_context_ring_attention():
+    """Long-context through the zero-touch CLI: KUBESHARE_TPU_MESH names
+    an sp axis, the transformer's mesh hooks swap in ring attention and
+    sequence-split token sharding — two processes, one model."""
+    port = free_port()
+    shim = REPO / "kubeshare_tpu" / "_shim"
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            PYTHONPATH=os.pathsep.join([str(shim), str(REPO)]),
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            KUBESHARE_TPU_MESH="dp=2,sp=2,tp=2",
+            **{
+                C.ENV_COORDINATOR: f"127.0.0.1:{port}",
+                C.ENV_NUM_PROCESSES: "2",
+                C.ENV_PROCESS_ID: str(rank),
+                C.ENV_GROUP_NAME: "longctx",
+            },
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubeshare_tpu.models.transformer",
+             "--steps", "2", "--platform", "cpu"],
+            env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    losses = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        assert p.returncode == 0, out[-2000:]
+        line = [l for l in out.splitlines() if "final loss" in l]
+        assert line, out[-2000:]
+        losses.append(line[0].split("final loss")[-1])
+    assert losses[0] == losses[1], losses
