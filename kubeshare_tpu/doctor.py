@@ -1,0 +1,174 @@
+"""Deploy-time health checks: ``python -m kubeshare_tpu.doctor``.
+
+The reference's deploy doc has the operator hand-verify each plane before
+installing the next (Prometheus endpoints, the ``gpu_capacity`` metric —
+``doc/deploy.md:137-146``); this command runs those checks in one shot:
+
+1. **chip** — can the JAX backend initialize, and how fast is a trivial
+   dispatch+host-read round trip? (Probed in a subprocess with a timeout:
+   a wedged transport hangs inside C where no Python timeout reaches.)
+2. **discovery** — do chips enumerate, with model/HBM/coords?
+3. **registry** — is the telemetry bus reachable; does ``/metrics``
+   render; how many capacity/requirement records live there?
+4. **scheduler** — is the service reachable; does ``/state`` show nodes?
+5. **node files** — does the per-chip client-list directory exist?
+
+Each check prints ``ok`` / ``fail`` / ``skip`` with one diagnostic line;
+exit code is non-zero when any check fails. Network checks are skipped unless
+their address is configured (flags or env) — a single-node dev box isn't
+failed for not running a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+from . import constants as C
+
+
+def _result(name: str, status: str, detail: str) -> bool:
+    print(f"{name:<12} {status:<5} {detail}")
+    return status != "fail"
+
+
+def check_chip(timeout_s: float) -> bool:
+    probe = ("import time; t0=time.time(); import jax; d=jax.devices(); "
+             "import jax.numpy as jnp; x=float(jnp.ones(8).sum()); "
+             "print(d[0].platform, d[0], round((time.time()-t0)*1000))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", probe],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return _result("chip", "fail",
+                       f"backend init hung > {timeout_s:.0f}s — transport "
+                       "wedged? (retry later; develop on cpu)")
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        return _result("chip", "fail", tail[-1] if tail else "unknown")
+    return _result("chip", "ok", proc.stdout.strip())
+
+
+def check_discovery(chip_ok: bool, timeout_s: float) -> bool:
+    if os.environ.get("KUBESHARE_TPU_FAKE_TOPOLOGY"):
+        from .topology.discovery import discover_chips
+        try:
+            chips = discover_chips("fake")
+        except Exception as exc:
+            return _result("discovery", "fail",
+                           f"{type(exc).__name__}: {exc}")
+        if not chips:
+            return _result("discovery", "fail", "fake topology is empty")
+        return _result("discovery", "ok",
+                       f"(fake) {len(chips)} chip(s); first: "
+                       f"{chips[0].chip_id}")
+    if not chip_ok:
+        # Live discovery initializes the backend in-process — on a wedged
+        # transport that hangs where no timeout can reach.
+        return _result("discovery", "skip",
+                       "chip unreachable; set KUBESHARE_TPU_FAKE_TOPOLOGY "
+                       "to exercise the fake path")
+    probe = ("from kubeshare_tpu.topology.discovery import discover_chips; "
+             "cs = discover_chips('jax'); c = cs[0]; "
+             "print(len(cs), c.chip_id, c.memory >> 30, c.coords)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", probe],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return _result("discovery", "fail", "hung — transport wedged?")
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        return _result("discovery", "fail", tail[-1] if tail else "unknown")
+    n, chip_id, gib, coords = proc.stdout.split(maxsplit=3)
+    return _result("discovery", "ok",
+                   f"{n} chip(s); first: {chip_id} {gib}GiB coords={coords}")
+
+
+def _get(url: str, timeout_s: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode()
+
+
+def check_registry(addr: str, timeout_s: float) -> bool:
+    if not addr:
+        return _result("registry", "skip", "no --registry (host:port)")
+    from .telemetry.registry import RegistryClient
+    host, _, port = addr.partition(":")
+    try:
+        # The real client path — the doctor validates what consumers use.
+        body = RegistryClient(host, int(port), timeout=timeout_s).metrics()
+    except Exception as exc:
+        return _result("registry", "fail", f"{addr}: {exc}")
+    cap = body.count("tpu_capacity{")
+    req = body.count("tpu_requirement{")
+    return _result("registry", "ok",
+                   f"{addr}: {cap} capacity / {req} requirement records")
+
+
+def check_scheduler(addr: str, timeout_s: float) -> bool:
+    if not addr:
+        return _result("scheduler", "skip", "no --scheduler (host:port)")
+    try:
+        state = json.loads(_get(f"http://{addr}/state", timeout_s))
+        nodes = state.get("nodes", state) if isinstance(state, dict) \
+            else state
+        n = len(nodes)
+    except Exception as exc:
+        return _result("scheduler", "fail", f"{addr}: {exc}")
+    return _result("scheduler", "ok", f"{addr}: {n} node(s) in the engine")
+
+
+def check_node_files(base_dir: str) -> bool:
+    cfg = os.path.join(base_dir, "config")
+    if not os.path.isdir(base_dir):
+        return _result("nodefiles", "skip", f"{base_dir} absent (no node "
+                       "agent on this host)")
+    if not os.path.isdir(cfg):
+        # Base dir without config/ = a node agent that died mid-setup —
+        # the exact broken state this check exists to surface.
+        return _result("nodefiles", "fail",
+                       f"{base_dir} exists but has no config/ directory")
+    return _result("nodefiles", "ok",
+                   f"{base_dir}: {len(os.listdir(cfg))} per-chip client "
+                   "file(s)")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu.doctor",
+                                     description=__doc__)
+    parser.add_argument("--registry",
+                        default=os.environ.get("KUBESHARE_TPU_REGISTRY", ""),
+                        help="registry host:port (e.g. 127.0.0.1:9006)")
+    parser.add_argument("--scheduler",
+                        default=os.environ.get("KUBESHARE_TPU_SCHEDULER", ""),
+                        help="scheduler service host:port")
+    parser.add_argument("--base-dir", default=C.SCHEDULER_DIR)
+    parser.add_argument("--chip-timeout", type=float, default=45.0)
+    parser.add_argument("--skip-chip", action="store_true",
+                        help="don't touch the accelerator (e.g. while the "
+                             "isolation runtime owns it)")
+    args = parser.parse_args(argv)
+
+    ok = True
+    chip_ok = False
+    if args.skip_chip:
+        _result("chip", "skip", "--skip-chip")
+    else:
+        chip_ok = check_chip(args.chip_timeout)
+        ok &= chip_ok
+    ok &= check_discovery(chip_ok, args.chip_timeout)
+    ok &= check_registry(args.registry, 5.0)
+    ok &= check_scheduler(args.scheduler, 5.0)
+    ok &= check_node_files(args.base_dir)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Doctor CLI — the reference's hand-run deploy-time checks in one shot."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from kubeshare_tpu.doctor import main as doctor_main
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.service import SchedulerService
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_doctor_all_planes_against_live_services(tmp_path, capsys,
+                                                 monkeypatch):
+    monkeypatch.setenv("KUBESHARE_TPU_FAKE_TOPOLOGY", "1:2x2@TPU-v5e")
+    registry = TelemetryRegistry()
+    reg_srv = registry.serve()
+    eng = SchedulerEngine()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=1, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+        registry.put_capacity(host, [c.to_labels() for c in chips])
+    svc = SchedulerService(eng, registry)
+    svc_srv = svc.serve()
+    (tmp_path / "config").mkdir()
+    (tmp_path / "config" / "TPU-chip-0").write_text("0\n")
+    try:
+        rc = doctor_main([
+            "--skip-chip",
+            "--registry", f"127.0.0.1:{reg_srv.server_address[1]}",
+            "--scheduler", f"127.0.0.1:{svc_srv.server_address[1]}",
+            "--base-dir", str(tmp_path),
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert out.count(" ok ") >= 3, out       # discovery+registry+sched
+        assert "capacity" in out and "node(s)" in out
+        assert "1 per-chip client file(s)" in out
+    finally:
+        svc.close()
+        reg_srv.shutdown()
+        reg_srv.server_close()
+
+
+def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
+    monkeypatch.setenv("KUBESHARE_TPU_FAKE_TOPOLOGY", "1:2x2")
+    rc = doctor_main(["--skip-chip", "--registry", "127.0.0.1:1",
+                      "--scheduler", "127.0.0.1:1"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert out.count("fail") == 2
+
+
+def test_doctor_cli_subprocess():
+    env = dict(os.environ, KUBESHARE_TPU_FAKE_TOPOLOGY="1:2x2",
+               PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubeshare_tpu.doctor", "--skip-chip"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(REPO))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "discovery" in proc.stdout
