@@ -12,6 +12,7 @@ layernorm/softmax accumulate fp32, static shapes throughout.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -29,6 +30,12 @@ HEADS = 8
 LAYERS = 4
 MLP_MULT = 4
 DTYPE = jnp.bfloat16
+
+if os.environ.get("KUBESHARE_TPU_TRANSFORMER_PRESET", "") == "small":
+    # CI / smoke preset: the full config costs minutes of CPU XLA compile
+    # per process in the multi-process gang tests. Same code paths,
+    # divisibility (sp/tp/heads/dp) preserved.
+    BATCH_SIZE, SEQ_LEN, VOCAB, DIM, HEADS, LAYERS = 4, 32, 64, 32, 4, 2
 
 
 def init(key, *, seq_len: int = SEQ_LEN, vocab: int = VOCAB, dim: int = DIM,

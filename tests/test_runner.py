@@ -277,6 +277,7 @@ def test_gang_cli_long_context_ring_attention():
             PYTHONPATH=os.pathsep.join([str(shim), str(REPO)]),
             XLA_FLAGS="--xla_force_host_platform_device_count=4",
             KUBESHARE_TPU_MESH="dp=2,sp=2,tp=2",
+            KUBESHARE_TPU_TRANSFORMER_PRESET="small",
             **{
                 C.ENV_COORDINATOR: f"127.0.0.1:{port}",
                 C.ENV_NUM_PROCESSES: "2",
