@@ -358,11 +358,20 @@ def _pin_visible_devices() -> bool:
         indices = [str(int(c.rsplit("-", 1)[1]))
                    for c in chips.split(",") if c]
     except (IndexError, ValueError):
-        log.warning("cannot parse local indices from %s=%r",
-                    C.ENV_VISIBLE_CHIPS, chips)
-        return False
+        # Fail CLOSED (like _join_gang_or_die): the grant env is present
+        # but unparsable, so we cannot know which chips are ours.  Falling
+        # through would leave TPU_VISIBLE_DEVICES unset and initialize
+        # EVERY chip on the host — including ones granted to other pods —
+        # which is exactly the breach the pin exists to prevent.  Crash
+        # loudly so a scheduler config bug shows up as a crash-looping pod.
+        raise SystemExit(
+            f"kubeshare-tpu: cannot parse local chip indices from "
+            f"{C.ENV_VISIBLE_CHIPS}={chips!r}; refusing to start without "
+            f"a device pin (would expose co-tenants' chips)")
     if not indices:
-        return False
+        raise SystemExit(
+            f"kubeshare-tpu: {C.ENV_VISIBLE_CHIPS}={chips!r} parses to an "
+            f"empty chip set; refusing to start without a device pin")
     os.environ["TPU_VISIBLE_DEVICES"] = ",".join(indices)
     return True
 

@@ -95,3 +95,9 @@ WINDOW_MS = 10000.0
 # Name under which the scheduler registers (scheduler.go:35-56's
 # Name = "kubeshare-scheduler").
 SCHEDULER_NAME = "kubeshare-tpu-scheduler"
+
+# Well-known control-plane service ports (deploy/registry.yaml:63,
+# deploy/scheduler.yaml:47; ≙ the reference's collector 9004 / aggregator
+# 9005 ports, cmd/kubeshare-collector/main.go + cmd/kubeshare-aggregator).
+REGISTRY_PORT = 9006
+SCHEDULER_PORT = 9007

@@ -14,9 +14,11 @@ installing the next (Prometheus endpoints, the ``gpu_capacity`` metric —
 5. **node files** — does the per-chip client-list directory exist?
 
 Each check prints ``ok`` / ``fail`` / ``skip`` with one diagnostic line;
-exit code is non-zero when any check fails. Network checks are skipped unless
-their address is configured (flags or env) — a single-node dev box isn't
-failed for not running a cluster.
+exit code is non-zero when any check fails. Network checks default to the
+deploy manifests' well-known service addresses (in-cluster DNS inside a
+pod, localhost on a bare host) so a zero-flag run on a deployed node
+checks every plane — pass ``--registry none`` / ``--scheduler none`` on a
+dev box that deliberately runs no cluster.
 """
 
 from __future__ import annotations
@@ -84,7 +86,15 @@ def check_discovery(chip_ok: bool, timeout_s: float) -> bool:
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout).strip().splitlines()
         return _result("discovery", "fail", tail[-1] if tail else "unknown")
-    n, chip_id, gib, coords = proc.stdout.split(maxsplit=3)
+    # The TPU runtime may interleave banners/absl logs into stdout; the
+    # probe's own line is the last one.  Parse defensively — a report tool
+    # must never die with a traceback mid-report.
+    lines = proc.stdout.strip().splitlines()
+    try:
+        n, chip_id, gib, coords = lines[-1].split(maxsplit=3)
+    except (IndexError, ValueError):
+        return _result("discovery", "fail",
+                       f"unexpected probe output: {proc.stdout!r:.200}")
     return _result("discovery", "ok",
                    f"{n} chip(s); first: {chip_id} {gib}GiB coords={coords}")
 
@@ -94,9 +104,22 @@ def _get(url: str, timeout_s: float) -> str:
         return resp.read().decode()
 
 
+# Well-known service addresses from the deploy manifests
+# (deploy/registry.yaml:57,63 / deploy/scheduler.yaml:42,47) — the doctor
+# defaults to these so a zero-flag run on a deployed node checks every
+# plane instead of skipping (the reference's deploy-time list is mandatory
+# reading, doc/deploy.md:137-146).  In-cluster we use service DNS; on a
+# bare host the master components are expected on localhost.  Pass
+# ``--registry none`` / ``--scheduler none`` to skip explicitly.
+def _default_addr(service: str, port: int) -> str:
+    if os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return f"{service}.kube-system.svc:{port}"
+    return f"127.0.0.1:{port}"
+
+
 def check_registry(addr: str, timeout_s: float) -> bool:
-    if not addr:
-        return _result("registry", "skip", "no --registry (host:port)")
+    if not addr or addr == "none":
+        return _result("registry", "skip", "--registry none")
     from .telemetry.registry import RegistryClient
     host, _, port = addr.partition(":")
     try:
@@ -111,8 +134,8 @@ def check_registry(addr: str, timeout_s: float) -> bool:
 
 
 def check_scheduler(addr: str, timeout_s: float) -> bool:
-    if not addr:
-        return _result("scheduler", "skip", "no --scheduler (host:port)")
+    if not addr or addr == "none":
+        return _result("scheduler", "skip", "--scheduler none")
     try:
         state = json.loads(_get(f"http://{addr}/state", timeout_s))
         nodes = state.get("nodes", state) if isinstance(state, dict) \
@@ -143,12 +166,18 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.doctor",
                                      description=__doc__)
-    parser.add_argument("--registry",
-                        default=os.environ.get("KUBESHARE_TPU_REGISTRY", ""),
-                        help="registry host:port (e.g. 127.0.0.1:9006)")
-    parser.add_argument("--scheduler",
-                        default=os.environ.get("KUBESHARE_TPU_SCHEDULER", ""),
-                        help="scheduler service host:port")
+    parser.add_argument(
+        "--registry",
+        default=os.environ.get("KUBESHARE_TPU_REGISTRY", "") or
+        _default_addr("kubeshare-tpu-registry", C.REGISTRY_PORT),
+        help="registry host:port; defaults to the deploy manifest's "
+             "service (or localhost); 'none' to skip")
+    parser.add_argument(
+        "--scheduler",
+        default=os.environ.get("KUBESHARE_TPU_SCHEDULER", "") or
+        _default_addr("kubeshare-tpu-scheduler", C.SCHEDULER_PORT),
+        help="scheduler service host:port; defaults to the deploy "
+             "manifest's service (or localhost); 'none' to skip")
     parser.add_argument("--base-dir", default=C.SCHEDULER_DIR)
     parser.add_argument("--chip-timeout", type=float, default=45.0)
     parser.add_argument("--skip-chip", action="store_true",

@@ -202,12 +202,18 @@ void serve_conn(const Config& cfg, int down) {
       if (op == "acquire" || op == "renew") {
         // Only a successful grant means we hold the token — an ok:false
         // reply (wait timeout, client removed) must not arm the
-        // crash-release path for a token this pod never held.
+        // crash-release path for a token this pod never held.  The
+        // converse also holds: TokenScheduler.renew releases the old
+        // token before re-requesting, so a grant-less reply means any
+        // previously-held token is gone — clear the flag or a later
+        // disconnect would crash-release (and double-charge) stale quota.
         double q = json_num(reply, "quota_ms", -1.0);
         if (q >= 0.0 && reply.find("\"ok\": true") != std::string::npos) {
           holding = true;
           quota_ms = q;
           grant_t = now_ms();
+        } else {
+          holding = false;
         }
       } else if (op == "release") {
         holding = false;

@@ -74,11 +74,39 @@ class PodManager:
                 up = protocol.Connection(*self._sched_addr)
                 up.call({"op": "attach", "name": self.pod_name})
                 state["up"] = up
-            reply, _ = up.call(dict(req, name=self.pod_name))
+            try:
+                reply, _ = up.call(dict(req, name=self.pod_name))
+            except OSError:
+                # Transport error: Connection.call closed the socket
+                # (fail-stop), so drop the corpse and disarm — the next
+                # call on this gate connection re-dials a fresh upstream
+                # instead of looping on a dead one (parity with
+                # podmgr_relay.cpp's break-and-reconnect, but recovering
+                # in place).
+                state["up"] = None
+                if op in ("acquire", "renew"):
+                    state["holding"] = False
+                raise
+            except RuntimeError:
+                # Upstream said ok:false (e.g. renew's re-request timed
+                # out).  The scheduler's renew releases the old token
+                # BEFORE re-requesting, so a failed acquire/renew means
+                # this pod no longer holds anything — leaving ``holding``
+                # armed would crash-release (and double-charge) stale
+                # quota on a later disconnect.  Same rule as
+                # podmgr_relay.cpp's grant-less-reply branch.
+                if op in ("acquire", "renew"):
+                    state["holding"] = False
+                raise
             if op in ("acquire", "renew"):
-                state["holding"] = True
-                state["quota_ms"] = float(reply.get("quota_ms", 0.0))
-                state["grant_t"] = time.monotonic()
+                # Hold only on a real grant (defensive: an ok reply
+                # without quota_ms is not a grant either).
+                if reply.get("quota_ms") is not None:
+                    state["holding"] = True
+                    state["quota_ms"] = float(reply["quota_ms"])
+                    state["grant_t"] = time.monotonic()
+                else:
+                    state["holding"] = False
             elif op == "release":
                 state["holding"] = False
             return reply

@@ -164,6 +164,19 @@ def test_whole_chip_visible_devices_not_overridden(monkeypatch):
     assert os.environ["TPU_VISIBLE_DEVICES"] == "0"
 
 
+def test_unparsable_chip_grant_fails_closed(monkeypatch):
+    """A malformed scheduler-written chip grant must CRASH the pod, not
+    silently leave TPU_VISIBLE_DEVICES unset (which would initialize every
+    chip on the host, including co-tenants' — ADVICE r3)."""
+    import pytest
+    from kubeshare_tpu import attach
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES", raising=False)
+    monkeypatch.setenv(C.ENV_VISIBLE_CHIPS, "garbage-without-index-")
+    with pytest.raises(SystemExit, match="refusing to start"):
+        attach.attach_if_env()
+    assert "TPU_VISIBLE_DEVICES" not in os.environ
+
+
 def test_gate_mode_also_pins_visible_devices(monkeypatch):
     """A gate-mode pod on a multi-chip host must be confined to its
     granted chip — pinning runs for every attach mode, not only the

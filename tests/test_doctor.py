@@ -61,8 +61,42 @@ def test_doctor_cli_subprocess():
     env = dict(os.environ, KUBESHARE_TPU_FAKE_TOPOLOGY="1:2x2",
                PYTHONPATH=str(REPO))
     proc = subprocess.run(
-        [sys.executable, "-m", "kubeshare_tpu.doctor", "--skip-chip"],
+        [sys.executable, "-m", "kubeshare_tpu.doctor", "--skip-chip",
+         "--registry", "none", "--scheduler", "none"],
         capture_output=True, text=True, timeout=120, env=env,
         cwd=str(REPO))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "discovery" in proc.stdout
+
+
+def test_doctor_zero_flags_defaults_bite(tmp_path, capsys, monkeypatch):
+    """With no flags the doctor must CHECK the well-known service
+    addresses (deploy/registry.yaml:63, deploy/scheduler.yaml:47), not
+    skip — a fresh deploy that forgot its components gets a non-zero
+    exit, mirroring the reference's mandatory deploy-time list
+    (doc/deploy.md:137-146)."""
+    import socket
+
+    import kubeshare_tpu.constants as C
+
+    monkeypatch.setenv("KUBESHARE_TPU_FAKE_TOPOLOGY", "1:2x2")
+    monkeypatch.delenv("KUBESHARE_TPU_REGISTRY", raising=False)
+    monkeypatch.delenv("KUBESHARE_TPU_SCHEDULER", raising=False)
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    # Hermetic: point the well-known ports at ports that are known-free
+    # on this machine (bound then released), and nodefiles at an absent
+    # dir (skip) — the test must not depend on what squats on 9006/9007.
+    free_ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        free_ports.append(s.getsockname()[1])
+        s.close()
+    monkeypatch.setattr(C, "REGISTRY_PORT", free_ports[0])
+    monkeypatch.setattr(C, "SCHEDULER_PORT", free_ports[1])
+    rc = doctor_main(["--skip-chip", "--base-dir", str(tmp_path / "absent")])
+    out = capsys.readouterr().out
+    assert f"127.0.0.1:{free_ports[0]}" in out, out
+    assert f"127.0.0.1:{free_ports[1]}" in out, out
+    assert rc == 1          # nothing listening on the defaults
+    assert out.count("fail") == 2, out

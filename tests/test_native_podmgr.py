@@ -102,6 +102,101 @@ def test_native_relay_crash_releases_token(relay_bin):
         srv.shutdown()
 
 
+def _failed_renew_then_crash(sched, srv, downstream_port, name):
+    """Shared scenario: a renew that times out (a competitor holds the
+    token) must DISARM the crash-release path — the scheduler's renew
+    releases the old token before re-requesting, so after an ok:false
+    renew the pod holds nothing, and a disconnect must not charge stale
+    quota (ADVICE r3: podmgr_relay.cpp stale-holding flag)."""
+    import threading
+
+    comp = protocol.Connection("127.0.0.1", srv.server_address[1])
+    comp.call({"op": "register", "name": "ns/comp", "request": 0.5,
+               "limit": 1.0})
+
+    down = protocol.Connection("127.0.0.1", downstream_port)
+    reply, _ = down.call({"op": "acquire"})
+    assert reply["quota_ms"] == BASE
+
+    def competitor():
+        comp.call({"op": "acquire"})       # granted when the renew releases
+        time.sleep(0.5)                    # outlive the renew's timeout
+        comp.call({"op": "release", "used_ms": 5.0})
+
+    t = threading.Thread(target=competitor)
+    t.start()
+    time.sleep(0.1)                        # competitor is waiting
+    with pytest.raises(RuntimeError):      # re-request times out → ok:false
+        down.call({"op": "renew", "used_ms": 30.0, "timeout": 0.2})
+    time.sleep(0.3)   # let wall time accrue: a stale crash-release would
+    down.close()      # charge ~min(wall, quota) ≈ BASE on top of the 30
+    t.join(timeout=5)
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and sched.window_usage(name) > 80.0:
+        time.sleep(0.02)
+    used = sched.window_usage(name)
+    assert used == pytest.approx(30.0, abs=20.0), (
+        f"stale crash-release double-charged: {used}ms")
+    comp.close()
+
+
+def test_native_relay_failed_renew_disarms_crash_release(relay_bin):
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    proc, port = start_relay(relay_bin, srv.server_address[1],
+                             name="ns/native-rn")
+    try:
+        _failed_renew_then_crash(sched, srv, port, "ns/native-rn")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        srv.shutdown()
+
+
+def test_python_podmgr_failed_renew_disarms_crash_release():
+    from kubeshare_tpu.isolation.podmgr import PodManager
+
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    mgr = PodManager("127.0.0.1", srv.server_address[1], "ns/py-rn",
+                     request=0.5, limit=1.0)
+    mgr_srv = mgr.serve()
+    try:
+        _failed_renew_then_crash(sched, srv, mgr_srv.server_address[1],
+                                 "ns/py-rn")
+    finally:
+        mgr.close()
+        srv.shutdown()
+
+
+def test_python_podmgr_redials_after_upstream_blip():
+    """A transport error on the upstream scheduler connection must not
+    wedge the gate forever: the manager drops the dead connection and
+    re-dials on the next call (the C++ relay breaks the gate connection
+    instead; both recover)."""
+    from kubeshare_tpu.isolation.podmgr import PodManager
+
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    mgr = PodManager("127.0.0.1", srv.server_address[1], "ns/blip",
+                     request=0.5, limit=1.0)
+    state: dict = {}
+    try:
+        assert mgr._handle({"op": "acquire"}, state)["quota_ms"] == BASE
+        mgr._handle({"op": "release", "used_ms": 10}, state)
+        state["up"].sock.close()          # network blip
+        with pytest.raises(OSError):
+            mgr._handle({"op": "acquire"}, state)
+        assert state["up"] is None        # corpse dropped
+        assert not state.get("holding")   # not armed across the blip
+        # same gate connection recovers: re-dial + attach + acquire
+        assert mgr._handle({"op": "acquire"}, state)["quota_ms"] == BASE
+        mgr._handle({"op": "release", "used_ms": 5}, state)
+    finally:
+        mgr.close()
+        srv.shutdown()
+
+
 def test_native_relay_two_connections_no_deadlock(relay_bin):
     sched = TokenScheduler(WINDOW, BASE, MIN)
     srv = serve(sched)
