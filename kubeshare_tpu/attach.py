@@ -312,16 +312,20 @@ def attach_proxy(host: str, port: int, name: str, request: float,
 
 
 def attach_gate(host: str, port: int, name: str, request: float,
-                limit: float) -> None:
+                limit: float, memory: int = 0) -> None:
     """Token-gate every jitted call; the workload keeps chip ownership
-    (whole-chip pods)."""
+    (whole-chip pods). ``memory`` > 0 arms the HBM cap: each gated call
+    polls the owned device's allocator and a breach kills the pod with an
+    attributable error (the hook's allocation-time ``gpu_mem`` cap,
+    ``pkg/scheduler/pod.go:419-424``)."""
     global _active
     with _state_lock:
         if _active is not None:
             raise RuntimeError(f"already attached ({_active.mode})")
-        from .isolation.client import ExecutionGate
+        from .isolation.client import ExecutionGate, HbmCap
 
         gate = ExecutionGate.connect(host, port, name, request, limit)
+        hbm = HbmCap(memory) if memory > 0 else None
         import jax
 
         real_jit = jax.jit
@@ -332,9 +336,14 @@ def attach_gate(host: str, port: int, name: str, request: float,
             jitted = real_jit(fn, **kw)
 
             def run(*args, **kwargs):
-                if not _contains_tracers(args, kwargs):
-                    gate()  # only meter real dispatches, not nested traces
-                return jitted(*args, **kwargs)
+                if _contains_tracers(args, kwargs):
+                    return jitted(*args, **kwargs)  # nested trace: no meter
+                gate()  # barriers the previous dispatch, charges, renews
+                if hbm is not None:
+                    hbm.check()  # deny the next step after a breach
+                out = jitted(*args, **kwargs)
+                gate.note_dispatch(out)  # charged through completion next
+                return out
 
             run.__wrapped__ = jitted
             return run
@@ -410,7 +419,7 @@ def attach_if_env() -> str:
         attach_proxy(host, proxy_port, name, request, limit, memory)
         return "proxy"
     if mgr_port and mode in ("", "gate"):
-        attach_gate(host, mgr_port, name, request, limit)
+        attach_gate(host, mgr_port, name, request, limit, memory)
         # Gate-mode pods own their device, so a fractional full gang can
         # still train one SPMD model across hosts (metered by tokens).
         _join_gang_or_die()

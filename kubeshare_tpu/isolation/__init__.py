@@ -11,14 +11,15 @@ client pods use :mod:`client` (buffer handles + traced programs), with
 token traffic relayed by their per-pod manager (:mod:`podmgr`).
 """
 
-from .client import ExecutionGate, ProxyClient, RemoteBuffer, RemoteExecutable
+from .client import (ExecutionGate, HbmCap, ProxyClient, RemoteBuffer,
+                     RemoteExecutable)
 from .podmgr import PodManager
 from .proxy import ChipProxy
 from .tokensched import (NativeTokenCore, PyTokenCore, TokenScheduler,
                          make_core, serve)
 
 __all__ = [
-    "ChipProxy", "ExecutionGate", "NativeTokenCore", "PodManager",
+    "ChipProxy", "ExecutionGate", "HbmCap", "NativeTokenCore", "PodManager",
     "ProxyClient", "PyTokenCore", "RemoteBuffer", "RemoteExecutable",
     "TokenScheduler", "make_core", "serve",
 ]

