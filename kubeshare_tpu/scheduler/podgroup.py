@@ -10,7 +10,7 @@ so a crash-looping member can rejoin its group's identity.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from .labels import PodRequest
 
@@ -25,6 +25,14 @@ class PodGroup:
     headcount: int
     threshold: float
     deletion_ts: float | None = None
+    #: cross-host shape-aware placement (gangplan.plan_gang): one
+    #: (node, chip_ids) slot per member, None until planned / after
+    #: invalidation. plan_taken maps pod key -> consumed slot index;
+    #: plan_stale_gen memoizes a failed planning attempt against the
+    #: engine's allocation generation (re-plan only after capacity moves).
+    plan: list | None = None
+    plan_taken: dict = field(default_factory=dict)
+    plan_stale_gen: int = -1
 
 
 class PodGroupRegistry:
