@@ -18,8 +18,17 @@ kubeshare_tpu/isolation/native/_build/podmgr_relay: kubeshare_tpu/isolation/nati
 	mkdir -p $(dir $@)
 	g++ -O2 -pthread -std=c++17 $< -o $@
 
+# Fast lane (< 3 min): everything but the compile-heavy/multi-process
+# tests. `make test-all` is the full suite; `make test-slow` only the
+# heavy lane (run both before release-grade changes).
 test:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+test-all:
 	$(PY) -m pytest tests/ -x -q
+
+test-slow:
+	$(PY) -m pytest tests/ -x -q -m slow
 
 bench:
 	$(PY) bench.py

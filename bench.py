@@ -201,18 +201,28 @@ def _proxied_trainer(proxy_port: int, name: str, request: float, limit: float,
             # token-gated device time (excludes wait + compile) — the same
             # quantity the scheduler's share accounting is fed with
             "exec_ms": c.usage()["exec_ms_total"] - used0,
+            # the burst controller's converged clamp — steady-state
+            # evidence for the latency-aware sizing (_cap_repeat)
+            "last_burst": loop.last_n,
         }
 
 
 def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
               settle_s: float | None = None,
-              exclusive_fused: bool | None = None) -> dict:
+              exclusive_fused: bool | None = None,
+              window_ms: float | None = None) -> dict:
     import jax
 
-    from kubeshare_tpu.constants import WINDOW_MS
+    from kubeshare_tpu.constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
     from kubeshare_tpu.isolation.proxy import ChipProxy
     from kubeshare_tpu.isolation.tokensched import TokenScheduler
 
+    # The accounting window defaults to Gemini parity (10 s). The CPU
+    # fallback passes a smaller one: its steps are ~1000x slower than the
+    # chip's, so 3+ windows of convergence fit an honest short run
+    # without hours of wall clock; quota/min keep their parity values.
+    if window_ms is None:
+        window_ms = WINDOW_MS
     platform = jax.devices()[0].platform
 
     exclusive_plain = _exclusive_steps_per_sec(exclusive_s)
@@ -229,9 +239,10 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
     if settle_s is None:
         # Skip the startup transient, but never settle longer than we
         # measure (toy-duration test runs).
-        settle_s = min(WINDOW_MS / 1000.0, colocated_s / 3.0)
+        settle_s = min(window_ms / 1000.0, colocated_s / 3.0)
 
-    proxy = ChipProxy(scheduler=TokenScheduler())
+    proxy = ChipProxy(scheduler=TokenScheduler(window_ms, BASE_QUOTA_MS,
+                                               MIN_QUOTA_MS))
     proxy.serve()
     try:
         barrier = threading.Barrier(2)
@@ -274,7 +285,9 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
                                  round(b["steps_per_sec"], 2)],
         "share_error_pct": round(share_error_pct, 2),
         "colocated_seconds": round(colocated_s, 1),
-        "windows_measured": round(colocated_s * 1000.0 / WINDOW_MS, 1),
+        "window_ms": round(window_ms, 0),
+        "windows_measured": round(colocated_s * 1000.0 / window_ms, 1),
+        "steady_state_burst": [a["last_burst"], b["last_burst"]],
         "platform": platform,
     }
 
@@ -307,8 +320,11 @@ def main(argv=None) -> int:
     if args.watchdog != 0.0:
         budget = args.watchdog
         if budget < 0:
+            # Slack covers XLA compiles AND the CPU fallback's own probe +
+            # exclusive + co-located phases (measured: the full fallback
+            # run needs ~300 s beyond the probe on a loaded CPU).
             budget = (args.probe_timeout + args.exclusive_seconds
-                      + args.colocated_seconds + 300.0)  # slack: XLA compiles
+                      + args.colocated_seconds + 480.0)
         raw = list(argv if argv is not None else sys.argv[1:])
         child_args, skip = [], False
         for a in raw:
@@ -344,12 +360,18 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
         try:
-            # Small knobs: CPU XLA compiles of the fused-loop buckets are
-            # tens of seconds each, and exclusive < 2.0 skips the fused
-            # exclusive baseline's extra compile — the whole fallback must
-            # fit the parent's watchdog budget alongside the probe time.
-            result = run_bench(1.5, min(args.colocated_seconds, 10.0),
-                               chunk=10, exclusive_fused=True)
+            # The fallback must meet the bench's OWN standard (≥ 3
+            # accounting windows for share convergence — the round-3
+            # number was recorded at 1 window and rightly discounted).
+            # CPU steps are ~1000x slower than the chip's, so the window
+            # is scaled to 3 s (quota parity kept): 12 s co-located = 4
+            # windows, and the whole fallback still fits the parent's
+            # watchdog alongside the probe and the CPU XLA compiles.
+            # Exclusive gets 3 s so the fused baseline measures more
+            # than one burst post-warmup.
+            result = run_bench(3.0, min(args.colocated_seconds, 12.0),
+                               chunk=10, exclusive_fused=True,
+                               window_ms=3000.0)
             result["platform"] = "cpu-fallback"
             result["tpu_error"] = err
             print(json.dumps(result))

@@ -318,15 +318,21 @@ class SchedulerEngine:
 
     @staticmethod
     def _plan_eligible(pod: PodRequest, group) -> bool:
-        """Only a whole-chip member whose ask matches the plan's slot size
-        may take (or be constrained by) a slot — a heterogeneous or
-        fractional member consuming a slot would be silently under- or
-        over-allocated (slot chips ≠ booked chips)."""
+        """Only a whole-chip member whose ask matches the plan's slot
+        size AND model may take (or be constrained/steered by) a slot —
+        a heterogeneous, fractional, or differently-model-pinned member
+        consuming a slot would be silently mis-allocated, and
+        constraining such a member to the planned nodes could deadlock
+        it (a v5e-pinned pod steered onto a v4 block passes no filter
+        anywhere)."""
         per = int(pod.request)
         if per < 1 or pod.request != per:
             return False
-        return group.plan is None or (group.plan
-                                      and per == len(group.plan[0][1]))
+        if group.plan is None:
+            return True
+        if pod.model and group.plan_model and pod.model != group.plan_model:
+            return False
+        return bool(group.plan) and per == len(group.plan[0][1])
 
     def _ensure_gang_plan(self, pod: PodRequest, group) -> None:
         """Compute the gang's cross-host shape-aware placement once, when
@@ -357,9 +363,14 @@ class SchedulerEngine:
             if plan is not None:
                 group.plan = plan
                 group.plan_taken = {}
-                log.info("gang %s planned: %d members x %d chip(s) over "
-                         "%s", group.name, group.headcount, per,
-                         {n for n, _ in plan})
+                group.plan_checked_gen = self.alloc_gen
+                # the model the block was enumerated over (for "" pods,
+                # the model of the chips actually chosen)
+                group.plan_model = (model or
+                                    self.leaf_cells[plan[0][1][0]].cell_type)
+                log.info("gang %s planned: %d members x %d chip(s) of %s "
+                         "over %s", group.name, group.headcount, per,
+                         group.plan_model, {n for n, _ in plan})
                 return
         group.plan_stale_gen = self.alloc_gen
 
@@ -389,13 +400,17 @@ class SchedulerEngine:
         if held is not None:  # idempotent: a retrying pod keeps its slot
             return held if group.plan[held][0] == node_name else None
         taken = set(group.plan_taken.values())
-        for i, (_, chip_ids) in enumerate(group.plan):
-            if i not in taken and not self._slot_intact(chip_ids):
-                log.info("gang %s plan invalidated: slot %d no longer "
-                         "whole-free", group.name, i)
-                group.plan = None
-                group.plan_taken = {}
-                return None
+        if group.plan_checked_gen != self.alloc_gen:
+            # Intactness can only change when capacity moved — memoized
+            # per allocation generation (filter runs per node per cycle).
+            for i, (_, chip_ids) in enumerate(group.plan):
+                if i not in taken and not self._slot_intact(chip_ids):
+                    log.info("gang %s plan invalidated: slot %d no "
+                             "longer whole-free", group.name, i)
+                    group.plan = None
+                    group.plan_taken = {}
+                    return None
+            group.plan_checked_gen = self.alloc_gen
         rank = pod.group_rank
         if (0 <= rank < len(group.plan) and rank not in taken
                 and group.plan[rank][0] == node_name):
@@ -464,12 +479,12 @@ class SchedulerEngine:
                                         self.mesh_shape)
         if pod.group_name:
             group = self.group_of(pod)
-            rank = self._prospective_rank(pod, group)
-            if (group.plan is not None and rank is not None
-                    and rank < len(group.plan)
-                    and rank not in group.plan_taken.values()
-                    and group.plan[rank][0] == node_name):
-                base += self.PLAN_RANK_BONUS
+            if group.plan is not None and self._plan_eligible(pod, group):
+                rank = self._prospective_rank(pod, group)
+                if (rank is not None and rank < len(group.plan)
+                        and rank not in group.plan_taken.values()
+                        and group.plan[rank][0] == node_name):
+                    base += self.PLAN_RANK_BONUS
         return base
 
     def _name_ordinals(self, pod: PodRequest) -> tuple[dict, bool]:

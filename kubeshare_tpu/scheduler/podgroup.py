@@ -33,6 +33,8 @@ class PodGroup:
     plan: list | None = None
     plan_taken: dict = field(default_factory=dict)
     plan_stale_gen: int = -1
+    plan_model: str = ""          # chip model the plan was computed over
+    plan_checked_gen: int = -1    # intactness scan memo (engine.alloc_gen)
 
 
 class PodGroupRegistry:

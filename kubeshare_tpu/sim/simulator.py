@@ -50,6 +50,18 @@ def parse_trace(text: str) -> list[TraceJob]:
     return jobs
 
 
+def synthesize_trace(n: int, rng: random.Random) -> list[TraceJob]:
+    """The synthetic arrival trace (one canonical definition — the bench
+    and the CLI must describe the same workload): offsets are
+    inter-arrival gaps (they CHAIN in :meth:`Simulator.run`, like the
+    reference's per-row sleeps), chip asks skew small with occasional
+    4/8-chip meshes, runtimes 30-600 s."""
+    return [TraceJob(rng.choice([0.0, 0.0, 1.0]),
+                     rng.choice([1, 1, 1, 2, 2, 4, 8]),
+                     rng.randint(30, 600))
+            for _ in range(n)]
+
+
 def synthesize_labels(job: TraceJob, rng: random.Random) -> dict:
     """Reference synthesis rule (simulator.py:60-71)."""
     if job.chips > 2:
@@ -179,13 +191,7 @@ def main(argv=None) -> None:
         parser.error("exactly one of --trace / --synthetic is required")
     if args.synthetic:
         import random
-        rng = random.Random(args.seed)
-        t = 0.0
-        jobs = []
-        for _ in range(args.synthetic):
-            t += rng.choice([0.0, 0.0, 1.0])
-            jobs.append(TraceJob(t, rng.choice([1, 1, 1, 2, 2, 4, 8]),
-                                 rng.randint(30, 600)))
+        jobs = synthesize_trace(args.synthetic, random.Random(args.seed))
     else:
         with open(args.trace) as f:
             jobs = parse_trace(f.read())

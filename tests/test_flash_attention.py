@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default lane
+
 from kubeshare_tpu.ops.attention import dot_product_attention, mha_apply, mha_init
 from kubeshare_tpu.ops.flash_attention import flash_attention
 

@@ -184,6 +184,35 @@ def test_fractional_member_never_consumes_a_plan_slot():
     assert b.port != 0                       # fractional pods get a port
 
 
+def test_model_pinned_member_of_other_model_is_not_plan_constrained():
+    """A member pinned to a model the plan was NOT computed over must
+    fall through to normal filtering — constraining it to the planned
+    nodes would deadlock it forever (its model does not exist there)."""
+    from kubeshare_tpu.topology.discovery import FakeTopology as FT
+
+    eng = SchedulerEngine()
+    for model, prefix in (("TPU-v4", "v4-host"), ("TPU-v5e", "v5-host")):
+        by_host: dict = {}
+        for chip in FT(hosts=1, mesh=(2, 2), model=model,
+                       host_prefix=prefix).chips():
+            by_host.setdefault(chip.host, []).append(chip)
+        for host, chips in sorted(by_host.items()):
+            eng.add_node(host, chips)
+    lbl_v4 = gang_labels("2", "mixed", 2)
+    lbl_v4[C.POD_TPU_MODEL] = "TPU-v4"
+    lbl_v5 = gang_labels("2", "mixed", 2)
+    lbl_v5[C.POD_TPU_MODEL] = "TPU-v5e"
+    m0 = eng.submit("ns", "x-0", lbl_v4)
+    m1 = eng.submit("ns", "x-1", lbl_v5)
+    b0 = eng.schedule(m0)             # plans over v4, takes a slot
+    group = eng.group_of(m0)
+    assert group.plan is not None and group.plan_model == "TPU-v4"
+    b1 = eng.schedule(m1)             # must NOT be pinned to the v4 block
+    assert b1.node == "v5-host-0"
+    assert b0.node == "v4-host-0"
+    assert "ns/x-1" not in group.plan_taken
+
+
 def test_plan_slots_order_neighbouring_ranks():
     """Slots are emitted along the block so consecutive ranks sit on ICI
     neighbours (ring collectives ride neighbour links)."""

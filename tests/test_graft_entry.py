@@ -8,6 +8,9 @@ import sys
 from pathlib import Path
 
 import jax
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from default lane
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 

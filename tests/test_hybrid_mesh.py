@@ -6,6 +6,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default lane
+
 from kubeshare_tpu.ops import dense_apply, dense_init, softmax_cross_entropy
 from kubeshare_tpu.parallel.mesh import (data_sharding, make_hybrid_mesh,
                                          make_sharded_train_step,

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default lane
+
 from kubeshare_tpu.models import MODEL_NAMES, get_model
 from kubeshare_tpu.models.common import make_train_step, run_training
 from kubeshare_tpu.parallel import (data_sharding, make_mesh,

@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from the default lane
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeshare_tpu.models import transformer

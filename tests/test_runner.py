@@ -11,6 +11,9 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from default lane
 
 from kubeshare_tpu import constants as C
 from kubeshare_tpu.parallel.runner import distributed_init_from_env
