@@ -105,12 +105,24 @@ def _parse_priority(labels: dict) -> int:
     return p
 
 
-def _parse_number(labels: dict, key: str) -> float | None:
+def _parse_number(labels: dict, key: str,
+                  max_decimals: int | None = None) -> float | None:
     raw = labels.get(key)
     if raw is None:
         return None
     if not _NUMBER.fullmatch(str(raw)):
         raise LabelError(f"{key} is not a non-negative number: {raw!r}")
+    if max_decimals is not None:
+        frac = str(raw).partition(".")[2]
+        if len(frac) > max_decimals:
+            # Share precision is a centi-chip: the cell bookkeeping snaps
+            # float residue at 1e-9 (topology.cell._snap), which is only
+            # sound when requests carry bounded precision — and a
+            # micro-fraction share is meaningless against a 300 ms
+            # scheduling quantum anyway.
+            raise LabelError(
+                f"{key} supports at most {max_decimals} decimal places: "
+                f"{raw!r}")
     return float(raw)
 
 
@@ -152,11 +164,12 @@ def parse_pod_labels(namespace: str, name: str, labels: dict,
     if not has_any:
         return pr  # regular workload
 
-    limit = _parse_number(labels, C.POD_TPU_LIMIT)
+    limit = _parse_number(labels, C.POD_TPU_LIMIT, max_decimals=2)
     if limit is None:
         raise LabelError(f"{C.POD_TPU_LIMIT} is required for TPU workloads")
 
-    request = _parse_number(labels, C.POD_TPU_REQUEST) or 0.0
+    request = _parse_number(labels, C.POD_TPU_REQUEST,
+                            max_decimals=2) or 0.0
     if request > limit:
         raise LabelError(f"tpu_request {request} > tpu_limit {limit}")
     if limit > 1.0:

@@ -180,13 +180,28 @@ class CellConstructor:
         return cell
 
 
+def _snap(value: float) -> float:
+    """Cancel binary-fraction residue in compute bookings.
+
+    Fractional requests like 0.3 have no exact float representation, so
+    a reserve/reclaim cycle leaves ``available`` at 0.9999999999999998 —
+    and since ``available_whole_cell`` floors it, every such cycle
+    PERMANENTLY erodes whole-cell capacity (a multi-chip pod would never
+    fit a chip that is actually free). Requests are validated to ≤ 2
+    decimals, so snapping to 1e-9 is far below real precision."""
+    rounded = round(value)
+    if abs(value - rounded) < 1e-9:
+        return float(rounded)
+    return round(value, 9)
+
+
 def reserve_resource(cell: Cell, request: float, memory: int) -> None:
     """Book ``request`` compute + ``memory`` bytes on *cell* and every
     ancestor (pod.go:479-501)."""
     cur: Cell | None = cell
     while cur is not None:
         cur.free_memory -= memory
-        cur.available -= request
+        cur.available = _snap(cur.available - request)
         cur.available_whole_cell = math.floor(cur.available)
         cur = cur.parent
 
@@ -196,7 +211,7 @@ def reclaim_resource(cell: Cell, request: float, memory: int) -> None:
     cur: Cell | None = cell
     while cur is not None:
         cur.free_memory += memory
-        cur.available += request
+        cur.available = _snap(cur.available + request)
         cur.available_whole_cell = math.floor(cur.available)
         cur = cur.parent
 

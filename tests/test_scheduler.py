@@ -56,6 +56,18 @@ def test_labels_request_exceeds_limit():
         parse_pod_labels("ns", "p", shared_labels("1.0", "0.5"))
 
 
+def test_labels_precision_capped_at_centichip():
+    """Shares carry at most 2 decimals: arbitrary-precision fractions
+    would defeat the cell bookkeeping's float-residue snap (and a
+    micro-share is meaningless against the 300 ms quantum)."""
+    with pytest.raises(LabelError, match="decimal places"):
+        parse_pod_labels("ns", "p", shared_labels("0.1234567894", "1.0"))
+    with pytest.raises(LabelError, match="decimal places"):
+        parse_pod_labels("ns", "p", shared_labels("0.5", "0.505"))
+    pod = parse_pod_labels("ns", "p", shared_labels("0.25", "1.0"))
+    assert pod.request == 0.25
+
+
 def test_labels_bad_number():
     with pytest.raises(LabelError, match="not a non-negative number"):
         parse_pod_labels("ns", "p", shared_labels("half", "1.0"))
