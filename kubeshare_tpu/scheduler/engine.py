@@ -731,7 +731,7 @@ class SchedulerEngine:
         if cached is not None:
             self._reclaim(cached)
         pod = parse_pod_labels(namespace, name, labels, uid=uid,
-                               node_name=node_name)
+                               node_name=node_name, lenient=True)
         pod.timestamp = self._clock()
         self.pod_status[pod.key] = pod
         self.groups.get_or_create(pod)

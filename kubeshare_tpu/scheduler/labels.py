@@ -106,23 +106,31 @@ def _parse_priority(labels: dict) -> int:
 
 
 def _parse_number(labels: dict, key: str,
-                  max_decimals: int | None = None) -> float | None:
+                  max_decimals: int | None = None,
+                  quantize: bool = False) -> float | None:
     raw = labels.get(key)
     if raw is None:
         return None
     if not _NUMBER.fullmatch(str(raw)):
         raise LabelError(f"{key} is not a non-negative number: {raw!r}")
     if max_decimals is not None:
-        frac = str(raw).partition(".")[2]
+        # Trailing zeros carry no precision ("0.250" == 0.25) — count
+        # significant fraction digits only.
+        frac = str(raw).partition(".")[2].rstrip("0")
         if len(frac) > max_decimals:
             # Share precision is a centi-chip: the cell bookkeeping snaps
             # float residue at 1e-9 (topology.cell._snap), which is only
             # sound when requests carry bounded precision — and a
             # micro-fraction share is meaningless against a 300 ms
             # scheduling quantum anyway.
-            raise LabelError(
-                f"{key} supports at most {max_decimals} decimal places: "
-                f"{raw!r}")
+            if not quantize:
+                raise LabelError(
+                    f"{key} supports at most {max_decimals} decimal "
+                    f"places: {raw!r}")
+            # lenient path (resync of an already-RUNNING pod bound under
+            # older rules): clamp rather than reject — losing the replay
+            # would silently over-commit the chip the pod still uses
+            return round(float(raw), max_decimals)
     return float(raw)
 
 
@@ -150,9 +158,15 @@ def parse_group_labels(labels: dict) -> tuple[str, int, float, int]:
 
 
 def parse_pod_labels(namespace: str, name: str, labels: dict,
-                     uid: str = "", node_name: str = "") -> PodRequest:
+                     uid: str = "", node_name: str = "",
+                     lenient: bool = False) -> PodRequest:
     """labels → :class:`PodRequest`; raises :class:`LabelError` on
-    malformed TPU labels (``getPodLabels``, pod.go:207-327)."""
+    malformed TPU labels (``getPodLabels``, pod.go:207-327).
+
+    ``lenient`` quantizes over-precise shares instead of rejecting —
+    ONLY for resyncing already-bound pods (validation rules may have
+    tightened since they were admitted; dropping their replay would
+    over-commit the capacity they still hold)."""
     pr = PodRequest(namespace=namespace, name=name, uid=uid,
                     node_name=node_name)
     (pr.group_name, pr.headcount, pr.threshold,
@@ -164,12 +178,13 @@ def parse_pod_labels(namespace: str, name: str, labels: dict,
     if not has_any:
         return pr  # regular workload
 
-    limit = _parse_number(labels, C.POD_TPU_LIMIT, max_decimals=2)
+    limit = _parse_number(labels, C.POD_TPU_LIMIT, max_decimals=2,
+                          quantize=lenient)
     if limit is None:
         raise LabelError(f"{C.POD_TPU_LIMIT} is required for TPU workloads")
 
-    request = _parse_number(labels, C.POD_TPU_REQUEST,
-                            max_decimals=2) or 0.0
+    request = _parse_number(labels, C.POD_TPU_REQUEST, max_decimals=2,
+                            quantize=lenient) or 0.0
     if request > limit:
         raise LabelError(f"tpu_request {request} > tpu_limit {limit}")
     if limit > 1.0:

@@ -66,6 +66,14 @@ def test_labels_precision_capped_at_centichip():
         parse_pod_labels("ns", "p", shared_labels("0.5", "0.505"))
     pod = parse_pod_labels("ns", "p", shared_labels("0.25", "1.0"))
     assert pod.request == 0.25
+    # trailing zeros carry no precision (fixed-width float formatting)
+    pod = parse_pod_labels("ns", "p", shared_labels("0.250", "1.00"))
+    assert pod.request == 0.25
+    # the resync path quantizes instead of rejecting: an already-RUNNING
+    # pod admitted under older rules must keep its booking on replay
+    pod = parse_pod_labels("ns", "p", shared_labels("0.125", "1.0"),
+                           lenient=True)
+    assert pod.request == pytest.approx(0.12)
 
 
 def test_labels_bad_number():
