@@ -1,0 +1,129 @@
+"""Parallelism-plane scaling evidence on the 8-device virtual mesh.
+
+The real perf targets live on the chip (``bench.py``); this script
+records what CAN be measured without one — the *scaling shape* of the
+sequence-parallel long-context path, which is hardware-independent
+arithmetic:
+
+- **memory**: dense attention materializes the (seq x seq) score matrix
+  per head; ring attention (``parallel/ringattention.py``) holds one
+  (seq/sp x seq/sp) block per ring step. Peak live bytes per device are
+  measured from the compiled executables, so the O(L^2) -> O(L^2/sp)
+  claim is checked against XLA's own accounting, not a formula.
+- **throughput**: steps/s of a causal-attention forward over growing
+  sequence lengths, dense (single device) vs ring over a 4-device ``sp``
+  mesh carved from the 8 forced virtual CPU devices, same global shapes.
+  CPU absolute numbers are meaningless for TPU; the relative curve is
+  context only (see the artifact's note).
+
+Run: ``python scripts/bench_parallel.py`` → one JSON object
+(committed as ``bench_parallel.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kubeshare_tpu.utils.virtualcpu import force_virtual_cpu  # noqa: E402
+
+if not force_virtual_cpu(8):
+    print(json.dumps({"error": "could not force 8 virtual CPU devices"}))
+    sys.exit(1)
+
+import jax                                                    # noqa: E402
+import jax.numpy as jnp                                       # noqa: E402
+import numpy as np                                            # noqa: E402
+from jax.sharding import Mesh                                 # noqa: E402
+
+from kubeshare_tpu.ops.attention import dot_product_attention  # noqa: E402
+from kubeshare_tpu.parallel.ringattention import (            # noqa: E402
+    make_ring_attention)
+
+B, H, D = 2, 4, 64      # batch, heads, head_dim (tiny: seq is the subject)
+SP = 4
+
+
+def peak_bytes(jitted, *args) -> int:
+    """XLA's own per-device peak-live-memory estimate for the compiled
+    program (compiler accounting — exact on TPU, an estimate on CPU but
+    produced by the same pass). Takes the ALREADY-jitted callable so the
+    compile is shared with the timing runs."""
+    compiled = jitted.lower(*args).compile()
+    analysis = compiled.memory_analysis()
+    if analysis is None:
+        raise RuntimeError("backend exposes no memory_analysis(); the "
+                           "memory column cannot be produced honestly")
+    return int(analysis.temp_size_in_bytes + analysis.output_size_in_bytes)
+
+
+def timed_steps(fn, args, seconds=3.0) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    n = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < seconds:
+        out = fn(*args)
+        jax.block_until_ready(out)
+        n += 1
+    return n / (time.perf_counter() - start)
+
+
+def main() -> None:
+    devices = np.array(jax.devices("cpu")[:SP])
+    mesh = Mesh(devices, ("sp",))
+    ring = make_ring_attention(mesh, causal=True)
+    ring_j = jax.jit(ring)
+    # THE canonical dense reference the ring path is validated against
+    # everywhere else (ops/attention.py; finite mask floor, fp32 scores)
+    dense_j = jax.jit(dot_product_attention, static_argnames=("causal",))
+
+    rows = []
+    for seq in (1024, 2048, 4096):
+        key = jax.random.PRNGKey(seq)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (B, seq, H, D)
+        q = jax.random.normal(kq, shape, jnp.float32)
+        k = jax.random.normal(kk, shape, jnp.float32)
+        v = jax.random.normal(kv, shape, jnp.float32)
+
+        ref = dense_j(q, k, v)
+        out = ring_j(q, k, v)
+        err = float(jnp.max(jnp.abs(ref - out)))
+
+        rows.append({
+            "seq": seq,
+            "max_abs_err_vs_dense": round(err, 6),
+            "dense_steps_per_sec": round(timed_steps(dense_j, (q, k, v)), 2),
+            f"ring_sp{SP}_steps_per_sec": round(
+                timed_steps(ring_j, (q, k, v)), 2),
+            "dense_peak_bytes": peak_bytes(dense_j, q, k, v),
+            f"ring_sp{SP}_peak_bytes": peak_bytes(ring_j, q, k, v),
+        })
+        print(f"seq={seq} done", file=sys.stderr)
+
+    result = {
+        "bench": ("long-context sequence parallelism (4-device sp mesh "
+                  "carved from 8 virtual CPU devices; dense single-device)"),
+        "global_shape": [B, "seq", H, D],
+        "sp": SP,
+        "rows": rows,
+        "note": (
+            "The memory column is the claim: XLA's compiled peak-live "
+            "accounting shows ~SPx reduction, which is what makes "
+            "sequences that OOM densely trainable at all. The CPU "
+            "throughput column is honest but NOT a TPU prediction: "
+            "virtual devices share one socket, so lax.ppermute is a "
+            "host memcpy and dense enjoys the full thread pool — on "
+            "real chips the ring rides ICI neighbour links "
+            "(scaling-book recipe) while dense simply cannot fit."),
+    }
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()
