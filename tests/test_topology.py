@@ -1,3 +1,5 @@
+from pathlib import Path
+
 import pytest
 
 from kubeshare_tpu.topology import (
@@ -15,7 +17,8 @@ from kubeshare_tpu.topology import (
     reserve_resource,
 )
 from kubeshare_tpu.topology.cell import CELL_FILLED, set_node_status
-from kubeshare_tpu.topology.cellconfig import ConfigError, check_physical_cells, parse_config
+from kubeshare_tpu.topology.cellconfig import (ConfigError,
+    check_physical_cells, load_config, parse_config)
 
 
 def heterogeneous_config() -> TopologyConfig:
@@ -303,3 +306,24 @@ def test_config_from_chips_keeps_independent_slices_separate():
     fused = [c for c in cfg2.cells
              if cfg2.cell_types[c.cell_type].is_node_level is False]
     assert len(fused) == 1 and len(fused[0].children) == 4
+
+
+@pytest.mark.parametrize(
+    "path",
+    sorted((Path(__file__).resolve().parent.parent
+            / "deploy" / "config").glob("*.yaml")),
+    ids=lambda p: p.name)
+def test_shipped_topology_configs_build(path):
+    """Every example topology under deploy/config/ must load, validate,
+    and build real cell trees (the reference ships four lab topologies;
+    a broken example config is a broken operator path)."""
+    cfg = load_config(str(path))  # parse+validate+BFS-infer (once:
+    # the ID inference is not idempotent — a second pass would qualify
+    # already-qualified IDs)
+    elements, priority = build_cell_chains(cfg.cell_types)
+    free_list = CellConstructor(elements, cfg.cells).build()
+    leaves = [leaf for levels in free_list.values() for cells in
+              levels.values() for cell in cells for leaf in cell.leaves()]
+    assert leaves, path.name
+    for chip_model in free_list:
+        assert chip_model in priority, chip_model
