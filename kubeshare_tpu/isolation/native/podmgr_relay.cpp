@@ -273,22 +273,41 @@ int main(int argc, char** argv) {
 
   // Register the pod's share on the scheduler (held for our lifetime —
   // its drop on our exit is the launcher's kill path freeing the share).
-  int reg = dial(cfg.sched_ip, cfg.sched_port);
-  if (reg < 0) {
-    std::fprintf(stderr, "cannot reach scheduler\n");
-    return 1;
-  }
-  {
-    char buf[256];
-    std::snprintf(buf, sizeof(buf),
-                  "{\"op\": \"register\", \"name\": \"%s\", \"request\": "
-                  "%.6f, \"limit\": %.6f}",
-                  json_escape(cfg.pod_name).c_str(), cfg.request, cfg.limit);
-    std::string r;
-    if (!rpc(reg, buf, r) || json_str(r, "error").size()) {
-      std::fprintf(stderr, "register failed: %s\n", r.c_str());
-      return 1;
+  // Retry the whole dial+register: the launcher brings the chip proxy
+  // (which serves the token port) and the pod managers up CONCURRENTLY,
+  // so the scheduler may be milliseconds away — exiting immediately just
+  // makes the launcher respawn-loop us through the same race. The
+  // register RPC is inside the loop (a proxy restarting between our
+  // dial and its reply hits the same race); same rule as podmgr.py.
+  int reg = -1;
+  char buf[256];
+  std::snprintf(buf, sizeof(buf),
+                "{\"op\": \"register\", \"name\": \"%s\", \"request\": "
+                "%.6f, \"limit\": %.6f}",
+                json_escape(cfg.pod_name).c_str(), cfg.request, cfg.limit);
+  for (int attempt = 0; attempt < 40; ++attempt) {
+    reg = dial(cfg.sched_ip, cfg.sched_port);
+    if (reg >= 0) {
+      std::string r;
+      if (rpc(reg, buf, r)) {
+        if (json_str(r, "error").size()) {
+          // The scheduler ANSWERED with a refusal (bad share params,
+          // duplicate name): retrying cannot help — surface it.
+          std::fprintf(stderr, "register failed: %s\n", r.c_str());
+          return 1;
+        }
+        break;  // registered
+      }
+      ::close(reg);
+      reg = -1;
     }
+    ::usleep(250 * 1000);
+  }
+  if (reg < 0) {
+    std::fprintf(stderr, "cannot reach scheduler at %s:%d (last errno: "
+                 "%s)\n", cfg.sched_ip.c_str(), cfg.sched_port,
+                 std::strerror(errno));
+    return 1;
   }
 
   int srv = ::socket(AF_INET, SOCK_STREAM, 0);

@@ -163,8 +163,23 @@ def main(argv=None) -> None:
                         default=float(os.environ.get("POD_LIMIT", "0")))
     args = parser.parse_args(argv)
 
-    mgr = PodManager(args.scheduler_ip, args.scheduler_port, args.pod_name,
-                     args.request, args.limit)
+    # Retry the initial register: the launcher brings the token scheduler
+    # (chip proxy) and pod managers up concurrently — same rule as the
+    # native relay.
+    mgr = None
+    last: OSError | None = None
+    for attempt in range(40):
+        try:
+            mgr = PodManager(args.scheduler_ip, args.scheduler_port,
+                             args.pod_name, args.request, args.limit)
+            break
+        except OSError as exc:
+            last = exc
+            time.sleep(0.25)
+    if mgr is None:
+        raise SystemExit(
+            f"cannot reach scheduler at {args.scheduler_ip}:"
+            f"{args.scheduler_port}: {last}")
     server = mgr.serve(port=args.port)
     print(f"READY {server.server_address[1]}", flush=True)
     stop = threading.Event()
