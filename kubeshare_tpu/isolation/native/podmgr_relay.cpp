@@ -136,11 +136,23 @@ std::string with_name(const std::string& req, const std::string& name) {
          "\"}" + req.substr(brace + 1);
 }
 
-int dial(const std::string& host, int port) {
+void set_io_timeout(int fd, int seconds) {
+  // seconds == 0 clears the timeout (blocking acquire waits are
+  // legitimate in steady state). SO_SNDTIMEO also bounds connect() on
+  // Linux, keeping each startup-retry attempt inside its budget instead
+  // of the kernel's ~2 min SYN backoff.
+  timeval tv{};
+  tv.tv_sec = seconds;
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+int dial(const std::string& host, int port, int timeout_s = 0) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (timeout_s > 0) set_io_timeout(fd, timeout_s);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
@@ -280,23 +292,37 @@ int main(int argc, char** argv) {
   // register RPC is inside the loop (a proxy restarting between our
   // dial and its reply hits the same race); same rule as podmgr.py.
   int reg = -1;
+  int last_errno = 0;
   char buf[256];
   std::snprintf(buf, sizeof(buf),
                 "{\"op\": \"register\", \"name\": \"%s\", \"request\": "
                 "%.6f, \"limit\": %.6f}",
                 json_escape(cfg.pod_name).c_str(), cfg.request, cfg.limit);
   for (int attempt = 0; attempt < 40; ++attempt) {
-    reg = dial(cfg.sched_ip, cfg.sched_port);
-    if (reg >= 0) {
+    // Per-attempt 2 s I/O deadline: a blackholed address must exhaust
+    // the ~10 s total budget, not the kernel's minutes-long SYN backoff
+    // multiplied by 40.
+    reg = dial(cfg.sched_ip, cfg.sched_port, /*timeout_s=*/2);
+    if (reg < 0) {
+      last_errno = errno;
+    } else {
       std::string r;
-      if (rpc(reg, buf, r)) {
-        if (json_str(r, "error").size()) {
-          // The scheduler ANSWERED with a refusal (bad share params,
-          // duplicate name): retrying cannot help — surface it.
+      bool ok = rpc(reg, buf, r);
+      last_errno = errno;
+      if (ok) {
+        std::string err = json_str(r, "error");
+        if (err.empty()) {
+          set_io_timeout(reg, 0);  // steady state: acquires block freely
+          break;                   // registered
+        }
+        // "duplicate client" is TRANSIENT in the launcher's
+        // kill-then-respawn path (the old owner's disconnect may not be
+        // reaped yet) — keep retrying it; any other refusal (bad share
+        // params) is permanent.
+        if (err.find("duplicate") == std::string::npos) {
           std::fprintf(stderr, "register failed: %s\n", r.c_str());
           return 1;
         }
-        break;  // registered
       }
       ::close(reg);
       reg = -1;
@@ -304,9 +330,9 @@ int main(int argc, char** argv) {
     ::usleep(250 * 1000);
   }
   if (reg < 0) {
-    std::fprintf(stderr, "cannot reach scheduler at %s:%d (last errno: "
+    std::fprintf(stderr, "cannot reach scheduler at %s:%d (last error: "
                  "%s)\n", cfg.sched_ip.c_str(), cfg.sched_port,
-                 std::strerror(errno));
+                 std::strerror(last_errno));
     return 1;
   }
 

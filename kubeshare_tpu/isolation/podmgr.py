@@ -39,14 +39,19 @@ class PodManager:
     """
 
     def __init__(self, scheduler_host: str, scheduler_port: int, pod_name: str,
-                 request: float, limit: float):
+                 request: float, limit: float,
+                 connect_timeout: float | None = None):
         self.pod_name = pod_name
         self.request = request
         self.limit = limit
         self._sched_addr = (scheduler_host, scheduler_port)
-        self._up = protocol.Connection(scheduler_host, scheduler_port)
+        self._up = protocol.Connection(scheduler_host, scheduler_port,
+                                       timeout=connect_timeout)
         self._up.call({"op": "register", "name": pod_name,
                        "request": request, "limit": limit})
+        # registration done: this connection just holds the ownership
+        # (its drop is the crash-cleanup signal) — drop the dial deadline
+        self._up.sock.settimeout(None)
         self._server: protocol.FramedServer | None = None
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> protocol.FramedServer:
@@ -165,17 +170,26 @@ def main(argv=None) -> None:
 
     # Retry the initial register: the launcher brings the token scheduler
     # (chip proxy) and pod managers up concurrently — same rule as the
-    # native relay.
+    # native relay. A 2 s per-attempt deadline keeps a blackholed address
+    # inside the ~10 s total budget; a "duplicate client" refusal is
+    # transient in the launcher's kill-then-respawn path (the old owner's
+    # disconnect may not be reaped yet) and retries too; any other
+    # refusal is permanent and fails fast.
     mgr = None
-    last: OSError | None = None
+    last: Exception | None = None
     for attempt in range(40):
         try:
             mgr = PodManager(args.scheduler_ip, args.scheduler_port,
-                             args.pod_name, args.request, args.limit)
+                             args.pod_name, args.request, args.limit,
+                             connect_timeout=2.0)
             break
         except OSError as exc:
             last = exc
-            time.sleep(0.25)
+        except RuntimeError as exc:   # scheduler ANSWERED with a refusal
+            if "duplicate client" not in str(exc):
+                raise SystemExit(f"register failed: {exc}")
+            last = exc
+        time.sleep(0.25)
     if mgr is None:
         raise SystemExit(
             f"cannot reach scheduler at {args.scheduler_ip}:"

@@ -346,6 +346,70 @@ def test_unmodified_mnist_runs_through_proxy_subprocess(proxy):
     assert "mnist-pod" not in proxy._sessions  # cleanly disconnected
 
 
+@pytest.mark.slow
+def test_unmodified_haiku_workload_through_proxy(proxy, tmp_path):
+    """Framework-agnosticism of the zero-touch contract (the reference
+    proves its hook on pytorch AND tensorflow workloads, test/mnist +
+    test/tensorflow): a dm-haiku training script — foreign user code,
+    not this repo's model style — attaches through env alone and trains
+    on the proxy."""
+    pytest.importorskip("haiku")
+    script = tmp_path / "haiku_mlp.py"
+    script.write_text("""
+import haiku as hk
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+def net_fn(x):
+    return hk.nets.MLP([32, 1])(x)
+
+net = hk.without_apply_rng(hk.transform(net_fn))
+rng = np.random.default_rng(0)
+x = rng.normal(size=(64, 8)).astype(np.float32)
+y = (x.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+params = net.init(jax.random.PRNGKey(0), x)
+opt = optax.adam(1e-2)
+opt_state = opt.init(params)
+
+@jax.jit
+def step(params, opt_state, x, y):
+    def loss_fn(p):
+        return jnp.mean((net.apply(p, x) - y) ** 2)
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, opt_state = opt.update(grads, opt_state)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+first = None
+for i in range(30):
+    params, opt_state, loss = step(params, opt_state, x, y)
+    if first is None:
+        first = float(loss)
+final = float(loss)
+print("first", first, "final", final)
+assert final < first * 0.5, (first, final)
+""")
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
+        **{
+            C.ENV_CHIP_PROXY_PORT: str(proxy.port),
+            C.ENV_ATTACH_MODE: "proxy",   # forced: fail rather than local
+            C.ENV_POD_NAME: "haiku-pod",
+            C.ENV_TPU_REQUEST: "0.5",
+            C.ENV_TPU_LIMIT: "1.0",
+        },
+    )
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, env=env,
+                          timeout=300, cwd=str(REPO))
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    assert "final" in proc.stdout
+    assert proxy.total_execs >= 30   # every step ran ON the proxy
+    assert "haiku-pod" not in proxy._sessions
+
+
 def test_whole_chip_pod_sets_visible_devices(monkeypatch):
     """Whole-chip pods (no manager port) get their granted chips pinned
     via TPU_VISIBLE_DEVICES, parsed from the chip ids' per-host index."""

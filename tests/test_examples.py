@@ -36,11 +36,18 @@ def pod_docs(path: Path):
             continue
         if doc.get("kind") == "Pod":
             yield doc
-        elif doc.get("kind") == "Job":
-            tpl = doc["spec"]["template"]
-            tpl.setdefault("kind", "Pod")
-            tpl["metadata"]["name"] = doc["metadata"]["name"]
-            yield tpl
+        elif doc.get("kind") in ("Job", "Deployment"):
+            # one pod per replica, distinct names — a Deployment whose
+            # replicas together oversubscribe the fleet must not pass on
+            # the strength of a single template
+            replicas = int(doc["spec"].get("replicas", 1) or 1)
+            for i in range(replicas):
+                import copy
+                tpl = copy.deepcopy(doc["spec"]["template"])
+                tpl.setdefault("kind", "Pod")
+                suffix = f"-{i}" if replicas > 1 else ""
+                tpl["metadata"]["name"] = doc["metadata"]["name"] + suffix
+                yield tpl
 
 
 def labels_of(doc) -> dict:

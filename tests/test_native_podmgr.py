@@ -197,6 +197,33 @@ def test_python_podmgr_redials_after_upstream_blip():
         srv.shutdown()
 
 
+def test_native_relay_retries_duplicate_until_old_owner_reaped(relay_bin):
+    """launcherd's kill-then-respawn can race the scheduler reaping the
+    old owner's disconnect: a 'duplicate client' refusal is transient
+    and must be retried, not treated as fatal."""
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    old = protocol.Connection("127.0.0.1", srv.server_address[1])
+    old.call({"op": "register", "name": "ns/respawn", "request": 0.5,
+              "limit": 1.0})
+    proc = subprocess.Popen(
+        [relay_bin, "--scheduler-ip", "127.0.0.1",
+         "--scheduler-port", str(srv.server_address[1]), "--port", "0",
+         "--pod-name", "ns/respawn", "--request", "0.5", "--limit", "1.0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        time.sleep(1.0)                 # replacement is in the retry loop
+        assert proc.poll() is None, proc.stderr.read()
+        old.close()                     # the old owner finally drops
+        line = proc.stdout.readline().strip()
+        assert line.startswith("READY "), proc.stderr.read()
+        assert sched.core.client_count() == 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        srv.shutdown()
+
+
 def test_native_relay_two_connections_no_deadlock(relay_bin):
     sched = TokenScheduler(WINDOW, BASE, MIN)
     srv = serve(sched)
