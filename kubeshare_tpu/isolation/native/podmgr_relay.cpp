@@ -293,15 +293,18 @@ int main(int argc, char** argv) {
   // dial and its reply hits the same race); same rule as podmgr.py.
   int reg = -1;
   int last_errno = 0;
+  std::string last_refusal;
   char buf[256];
   std::snprintf(buf, sizeof(buf),
                 "{\"op\": \"register\", \"name\": \"%s\", \"request\": "
                 "%.6f, \"limit\": %.6f}",
                 json_escape(cfg.pod_name).c_str(), cfg.request, cfg.limit);
   for (int attempt = 0; attempt < 40; ++attempt) {
-    // Per-attempt 2 s I/O deadline: a blackholed address must exhaust
-    // the ~10 s total budget, not the kernel's minutes-long SYN backoff
-    // multiplied by 40.
+    // Per-attempt 2 s I/O deadline. Total budget: ~10 s when the
+    // address answers with refusals (connects fail instantly), ~90 s
+    // worst case against a blackholed address (2 s timeout + 0.25 s
+    // sleep per attempt) — bounded either way, vs the kernel's
+    // minutes-long SYN backoff multiplied by 40.
     reg = dial(cfg.sched_ip, cfg.sched_port, /*timeout_s=*/2);
     if (reg < 0) {
       last_errno = errno;
@@ -323,6 +326,7 @@ int main(int argc, char** argv) {
           std::fprintf(stderr, "register failed: %s\n", r.c_str());
           return 1;
         }
+        last_refusal = err;
       }
       ::close(reg);
       reg = -1;
@@ -330,9 +334,16 @@ int main(int argc, char** argv) {
     ::usleep(250 * 1000);
   }
   if (reg < 0) {
-    std::fprintf(stderr, "cannot reach scheduler at %s:%d (last error: "
-                 "%s)\n", cfg.sched_ip.c_str(), cfg.sched_port,
-                 std::strerror(last_errno));
+    if (!last_refusal.empty()) {
+      // the scheduler WAS reachable — report the actual refusal, not a
+      // stale errno (e.g. two pods misconfigured with the same name)
+      std::fprintf(stderr, "register failed after retries: %s\n",
+                   last_refusal.c_str());
+    } else {
+      std::fprintf(stderr, "cannot reach scheduler at %s:%d (last "
+                   "error: %s)\n", cfg.sched_ip.c_str(), cfg.sched_port,
+                   std::strerror(last_errno));
+    }
     return 1;
   }
 

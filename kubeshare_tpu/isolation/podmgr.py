@@ -170,8 +170,9 @@ def main(argv=None) -> None:
 
     # Retry the initial register: the launcher brings the token scheduler
     # (chip proxy) and pod managers up concurrently — same rule as the
-    # native relay. A 2 s per-attempt deadline keeps a blackholed address
-    # inside the ~10 s total budget; a "duplicate client" refusal is
+    # native relay. Per-attempt 2 s deadline → total budget ~10 s when
+    # the address refuses, ~90 s worst case against a blackholed one
+    # (bounded either way); a "duplicate client" refusal is
     # transient in the launcher's kill-then-respawn path (the old owner's
     # disconnect may not be reaped yet) and retries too; any other
     # refusal is permanent and fails fast.

@@ -410,6 +410,66 @@ assert final < first * 0.5, (first, final)
     assert "haiku-pod" not in proxy._sessions
 
 
+def test_shim_fails_closed_when_attach_requested_but_unreachable():
+    """A pod whose env requests an attach must DIE when the manager /
+    proxy is unreachable — silently running unmetered is an isolation
+    breach (the reference's LD_PRELOAD contract likewise fails the exec
+    on a missing hook, it never skips interception)."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
+        **{
+            C.ENV_ATTACH_MODE: "gate",
+            C.ENV_POD_MANAGER_PORT: "1",     # nothing listens here
+            C.ENV_POD_NAME: "doomed",
+            C.ENV_TPU_REQUEST: "1",
+            C.ENV_TPU_LIMIT: "1",
+        },
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", "print('RAN UNMETERED')"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode != 0
+    assert "RAN UNMETERED" not in proc.stdout
+    assert "refusing to run unmetered" in proc.stderr
+
+
+def test_shim_fails_closed_even_when_package_unimportable(tmp_path):
+    """The shim must not depend on the package it guards: with attach
+    requested but kubeshare_tpu itself missing/broken on the node, the
+    pod still dies instead of running unmetered."""
+    import shutil
+    shutil.copy(SHIM / "sitecustomize.py", tmp_path / "sitecustomize.py")
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "PYTHONPATH": str(tmp_path),          # shim only — no package
+        C.ENV_ATTACH_MODE: "gate",
+        C.ENV_POD_MANAGER_PORT: "1",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", "print('RAN UNMETERED')"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode != 0
+    assert "RAN UNMETERED" not in proc.stdout
+    assert "refusing to run unmetered" in proc.stderr
+
+
+def test_shim_noop_without_kubeshare_env():
+    """The shim is installed globally on the node: processes without
+    kubeshare env must be completely untouched."""
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]))
+    for var in (C.ENV_CHIP_PROXY_PORT, C.ENV_POD_MANAGER_PORT,
+                C.ENV_ATTACH_MODE, C.ENV_VISIBLE_CHIPS):
+        env.pop(var, None)
+    proc = subprocess.run(
+        [sys.executable, "-c", "print('plain python ok')"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "plain python ok" in proc.stdout
+    assert "shim failed" not in proc.stderr
+
+
 def test_whole_chip_pod_sets_visible_devices(monkeypatch):
     """Whole-chip pods (no manager port) get their granted chips pinned
     via TPU_VISIBLE_DEVICES, parsed from the chip ids' per-host index."""

@@ -4,6 +4,7 @@ valid TPU workload is actually placed on a fake fleet — the reference's
 pod1-10 battery was checked by eyeball (`test/pod1.yaml:1-2`); here it is
 checked by CI."""
 
+import copy
 from pathlib import Path
 
 import pytest
@@ -42,7 +43,6 @@ def pod_docs(path: Path):
             # the strength of a single template
             replicas = int(doc["spec"].get("replicas", 1) or 1)
             for i in range(replicas):
-                import copy
                 tpl = copy.deepcopy(doc["spec"]["template"])
                 tpl.setdefault("kind", "Pod")
                 suffix = f"-{i}" if replicas > 1 else ""

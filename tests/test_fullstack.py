@@ -83,6 +83,93 @@ def kubelet_env(pod: dict, exec_ports: dict) -> dict:
     }
 
 
+def test_full_stack_gate_mode_whole_chip_pod(tmp_path):
+    """The second attach mode through the same full stack: a whole-chip
+    pod (request=1, limit=1) keeps device ownership and is token-METERED
+    through the launcherd-spawned pod manager (gem-pmgr parity). Usage
+    queried from the manager after the run proves real charging."""
+    node = "tpu-host-0"
+    chips = FakeTopology(hosts=1, mesh=(1,)).chips()
+    chip_ids = [c.chip_id for c in chips]
+
+    registry = TelemetryRegistry()
+    registry.put_capacity(node, [c.to_labels() for c in chips])
+    eng = SchedulerEngine()
+    svc = SchedulerService(eng, registry)
+    svc.serve()
+    api = FakeKubeAPI()
+    bridge = PodEventBridge(ServiceClient(f"http://127.0.0.1:{svc.port}"),
+                            KubeClient(api.url), scheduler_name=SCHED)
+    base = str(tmp_path)
+    configd = ConfigDaemon(registry, node, chip_ids, base_dir=base,
+                           period_s=0.05)
+    launcherd = LauncherDaemon(chip_ids, base_dir=base, poll_s=0.05,
+                               proxy_cmd=cpu_proxy_cmd)
+    try:
+        configd.start()
+        launcherd.start()
+        key = api.add_pod(make_pod("whole-pod", labels={
+            C.POD_TPU_REQUEST: "1", C.POD_TPU_LIMIT: "1"}))
+        bridge.sync_once()
+        pod = api.pods[key]
+        ann = pod["metadata"]["annotations"]
+        mgr_port = int(ann[C.POD_MANAGER_PORT])
+        mkey = (chip_ids[0], key)
+        assert wait_for(lambda: mkey in launcherd._managers)
+
+        # Wait for the manager to BIND (it registers upstream first; a
+        # pod starting earlier crash-loops by design — the shim fails
+        # closed rather than running unmetered).
+        from kubeshare_tpu.isolation import protocol
+        conn = None
+        deadline = time.monotonic() + 60
+        while conn is None:
+            try:
+                conn = protocol.Connection("127.0.0.1", mgr_port)
+            except OSError:
+                assert time.monotonic() < deadline, "manager never bound"
+                time.sleep(0.25)
+
+        labels = pod["metadata"]["labels"]
+        env = dict(os.environ,
+                   PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
+                   **{
+                       C.ENV_ATTACH_MODE: "gate",
+                       C.ENV_POD_MANAGER_PORT: str(mgr_port),
+                       C.ENV_POD_NAME: key,
+                       C.ENV_TPU_REQUEST: labels[C.POD_TPU_REQUEST],
+                       C.ENV_TPU_LIMIT: labels[C.POD_TPU_LIMIT],
+                   })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubeshare_tpu.models.mnist",
+             "--steps", "50", "--platform", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=str(REPO))
+        # the gate charges the sliding window at renew time; the window
+        # is 10 s, so usage must be observed DURING the run (charges from
+        # the compile phase expire before a post-exit query)
+        used = 0.0
+        with conn:
+            conn.call({"op": "register"})
+            poll_deadline = time.monotonic() + 240
+            while (time.monotonic() < poll_deadline
+                   and proc.poll() is None):
+                reply, _ = conn.call({"op": "usage"})
+                used = max(used, reply["used_ms"])
+                if used > 0:
+                    break
+                time.sleep(0.25)
+        out, _ = proc.communicate(timeout=300)
+        assert proc.returncode == 0, out[-3000:]
+        assert "final loss" in out
+        assert used > 0, "gate never charged the sliding window"
+    finally:
+        launcherd.stop()
+        configd.stop()
+        svc.close()
+        api.close()
+
+
 def test_full_stack_pod_to_training(tmp_path):
     node = "tpu-host-0"
     chips = FakeTopology(hosts=1, mesh=(1,)).chips()
