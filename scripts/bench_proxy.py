@@ -1,0 +1,121 @@
+"""Proxy-transport micro-benchmark: the isolation runtime's own overhead.
+
+SURVEY §7.3's hard part #1 is keeping the PJRT-proxying overhead — the
+serialize/socket/token-gate path around each remote execution — far
+below one training step. That overhead is protocol work, not device
+work, so it IS meaningful on the CPU backend (on the chip it sits in
+series with the ~68 ms tunnelled dispatch the burst controller already
+amortizes; on a local chip it is the whole added cost):
+
+- ``execute_rtt_ms``: round-trip of a trivial compiled program through
+  register→execute→reply, p50/p99 — the per-dispatch floor the fused
+  loop amortizes away.
+- ``put/get_gbps``: host↔proxy buffer bandwidth over the framed socket
+  (64 MiB array, chunked path).
+- ``fused_loop_per_step_us``: marginal cost per fused training step at
+  a 64-step burst — what co-located clients actually pay per step.
+
+Run: ``python scripts/bench_proxy.py`` → one JSON object
+(committed as ``bench_proxy.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeshare_tpu.isolation.client import ProxyClient
+    from kubeshare_tpu.isolation.proxy import ChipProxy
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    proxy = ChipProxy(scheduler=TokenScheduler())
+    proxy.serve()
+    out: dict = {"bench": "proxy transport overhead (CPU backend)"}
+    try:
+        with ProxyClient("127.0.0.1", proxy.port, "bench", 1.0, 1.0) as c:
+            # --- dispatch round trip on a trivial program ---------------
+            exe = c.compile(lambda x: x + 1.0, np.float32(0))
+            buf = c.put(np.float32(0))
+            for _ in range(20):           # warm: compile + token steady
+                c.free(exe(buf))
+            rtts = []
+            for _ in range(300):
+                t0 = time.perf_counter()
+                res = exe(buf)
+                rtts.append((time.perf_counter() - t0) * 1e3)
+                c.free(res)
+            out["execute_rtt_ms_p50"] = round(statistics.median(rtts), 3)
+            out["execute_rtt_ms_p99"] = round(
+                sorted(rtts)[int(len(rtts) * 0.99) - 1], 3)
+
+            # --- transfer bandwidth (chunked path) ----------------------
+            big = np.random.default_rng(0).random(
+                (16 << 20,)).astype(np.float32)         # 64 MiB (fp32:
+            #                       jax without x64 truncates float64)
+            puts, gets = [], []
+            for i in range(3):              # median beats one cold sample
+                t0 = time.perf_counter()
+                bbuf = c.put(big)
+                puts.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                back = c.get(bbuf)
+                gets.append(time.perf_counter() - t0)
+                if i == 0:  # the chunked path's correctness, not just size
+                    assert np.array_equal(back, big)
+                c.free(bbuf)
+            gbits = big.nbytes / 1e9 * 8    # decimal Gbit (NIC convention)
+            out["put_gbps"] = round(gbits / statistics.median(puts), 2)
+            out["get_gbps"] = round(gbits / statistics.median(gets), 2)
+
+            # --- fused-loop marginal per-step cost ----------------------
+            def step(carry, k):
+                w, s = carry
+                w = w - 0.01 * (w @ k)
+                return (w, s + jnp.sum(w)), jnp.float32(0)
+
+            w = np.eye(64, dtype=np.float32)
+            carry = (c.put(w), c.put(np.float32(0)))
+            kbuf = c.put(np.eye(64, dtype=np.float32))
+            loop = c.compile_loop(step, carry, kbuf)
+            for _ in range(4):
+                # warm: the first call is clamped to 1 step (cost model
+                # unseeded), later calls bucket to 64 — only the n=1 and
+                # n=64 programs compile, which are exactly the two timed
+                carry, aux = loop(64, carry, kbuf)
+                c.free(aux)
+            n1, n64 = [], []
+            for _ in range(40):
+                t0 = time.perf_counter()
+                carry, aux = loop(1, carry, kbuf)
+                n1.append(time.perf_counter() - t0)
+                c.free(aux)
+                t0 = time.perf_counter()
+                carry, aux = loop(64, carry, kbuf)
+                assert loop.last_n == 64, loop.last_n
+                n64.append(time.perf_counter() - t0)
+                c.free(aux)
+            per_step_us = (statistics.median(n64) - statistics.median(n1)) \
+                / 63 * 1e6
+            out["fused_loop_per_step_us"] = round(per_step_us, 1)
+            out["single_dispatch_ms_p50"] = round(
+                statistics.median(n1) * 1e3, 3)
+    finally:
+        proxy.close()
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()
