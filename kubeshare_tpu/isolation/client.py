@@ -173,6 +173,7 @@ class ProxyClient:
     def put(self, array) -> RemoteBuffer:
         arr = np.asarray(array)
         blob = dump_array(arr)
+        view = memoryview(blob)   # zero-copy slicing for the chunked path
         chunk = self._chunk()
         if len(blob) <= chunk:
             reply, _ = self._conn.call({"op": "put", "name": self.name},
@@ -186,7 +187,7 @@ class ProxyClient:
                 for off in range(0, len(blob), chunk):
                     self._conn.call({"op": "put_chunk", "name": self.name,
                                      "staging": sid, "offset": off},
-                                    blob=blob[off:off + chunk])
+                                    blob=view[off:off + chunk])
                 reply, _ = self._conn.call({"op": "put_commit",
                                             "name": self.name,
                                             "staging": sid})
@@ -218,7 +219,9 @@ class ProxyClient:
             assert part
             raw[off:off + len(part)] = part
             off += len(part)
-        return load_array(bytes(raw))
+        # zero-copy: the array views the reassembly buffer (mutable, so
+        # the user-facing result stays writable without a copy)
+        return load_array(raw)
 
     def free(self, *bufs) -> None:
         import jax

@@ -22,16 +22,53 @@ MAX_FRAME = 1 << 30
 
 
 def dump_array(arr) -> bytes:
-    """numpy array → .npy bytes (the blob format for device buffers)."""
+    """numpy array → .npy bytes (the blob format for device buffers).
+
+    Hand-assembled from the npy header + the raw data (one copy) instead
+    of ``np.save`` into a growing BytesIO (several copies) — for a
+    64 MiB buffer this alone is ~2x. Wire format is unchanged."""
     import numpy as np
-    buf = io.BytesIO()
-    np.save(buf, np.asarray(arr), allow_pickle=False)
-    return buf.getvalue()
+    # order="C" (NOT ascontiguousarray, which promotes 0-d scalars to
+    # shape-(1,)) — copies only when the input isn't already C-ordered
+    arr = np.asarray(arr, order="C")
+    if arr.dtype.hasobject:
+        # np.save(allow_pickle=False) used to reject these locally;
+        # serializing them would stream raw PyObject POINTERS
+        raise ValueError("object arrays cannot cross the proxy wire")
+    hdr = io.BytesIO()  # write_array_header_* emits magic+version itself
+    np.lib.format.write_array_header_2_0(
+        hdr, np.lib.format.header_data_from_array_1_0(arr))
+    return b"".join([hdr.getvalue(), arr.tobytes()])
 
 
-def load_array(blob: bytes):
+def load_array(blob, writable: bool = True):
+    """.npy bytes (or any byte buffer: bytearray, memoryview) → array.
+
+    Parses the header and views the data with ``np.frombuffer`` instead
+    of ``np.load``'s read-and-copy (~50 ms → ~1 ms for 64 MiB).
+    ``writable=True`` (callers handing the array to user code) returns a
+    mutable array — zero-copy when the source buffer is itself mutable
+    (the chunked get's reassembly bytearray), one copy otherwise;
+    ``writable=False`` returns a READ-ONLY zero-copy view — right for
+    paths that immediately copy onward (device puts)."""
     import numpy as np
-    return np.load(io.BytesIO(blob), allow_pickle=False)
+    mv = memoryview(blob)
+    # the npy header is tiny; parse it from a bounded prefix so giant
+    # payloads never round-trip through BytesIO
+    fp = io.BytesIO(bytes(mv[:min(mv.nbytes, 65536)]))
+    version = np.lib.format.read_magic(fp)
+    read_header = (np.lib.format.read_array_header_1_0 if version == (1, 0)
+                   else np.lib.format.read_array_header_2_0)
+    shape, fortran, dtype = read_header(fp)
+    if dtype.hasobject:      # never produced by dump_array; be safe
+        return np.load(io.BytesIO(bytes(mv)), allow_pickle=False)
+    arr = np.frombuffer(blob, dtype=dtype, offset=fp.tell())
+    arr = arr.reshape(shape, order="F" if fortran else "C")
+    if writable:
+        return arr if arr.flags.writeable else arr.copy()
+    if arr.flags.writeable:
+        arr.flags.writeable = False
+    return arr
 
 
 class ProtocolError(ConnectionError):
@@ -45,24 +82,39 @@ class FrameTooLarge(ValueError):
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ProtocolError("peer closed mid-frame" if buf else "peer closed")
-        buf.extend(chunk)
+    # Preallocate + recv_into: the naive recv/extend loop tops out well
+    # under 0.5 GB/s on loopback (per-chunk temporaries); this path does
+    # multi-GB/s and checkpoint-sized buffers ride it.
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
+            raise ProtocolError("peer closed mid-frame" if got
+                                else "peer closed")
+        got += r
     return bytes(buf)
 
 
-def send_msg(sock: socket.socket, msg: dict, blob: bytes | None = None) -> None:
+def send_msg(sock: socket.socket, msg: dict, blob=None) -> None:
+    """``blob`` may be bytes or any buffer (memoryview) — sent as-is
+    after the JSON frame, never concatenated (a header+blob join would
+    copy the whole payload). Length accounting is BYTES (``nbytes``),
+    never element count — a non-byte memoryview would otherwise desync
+    the framing."""
+    nblob = 0
     if blob is not None:
-        if len(blob) > MAX_FRAME:
-            raise FrameTooLarge(f"blob too large: {len(blob)}")
-        msg = dict(msg, _blob=len(blob))
+        nblob = memoryview(blob).nbytes
+        if nblob > MAX_FRAME:
+            raise FrameTooLarge(f"blob too large: {nblob}")
+        msg = dict(msg, _blob=nblob)
     data = json.dumps(msg).encode()
     if len(data) > MAX_FRAME:
         raise FrameTooLarge(f"frame too large: {len(data)}")
-    sock.sendall(_HDR.pack(len(data)) + data + (blob or b""))
+    sock.sendall(_HDR.pack(len(data)) + data)
+    if nblob:
+        sock.sendall(blob)
 
 
 def recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:

@@ -374,7 +374,9 @@ class ChipProxy:
         sess = self._session(name)
 
         if op == "put":
-            return self._put_array(sess, load_array(state["blob"]))
+            return self._put_array(sess,
+                                   load_array(state["blob"],
+                                              writable=False))
 
         if op == "put_begin":
             # Chunked upload: stage the serialized (.npy) stream host-side
@@ -408,7 +410,9 @@ class ChipProxy:
 
         if op == "put_commit":
             total, raw = sess.staging.pop(int(req["staging"]))
-            return self._put_array(sess, load_array(bytes(raw)))
+            # load_array views the bytearray directly — bytes(raw) would
+            # double peak host memory on checkpoint-sized uploads
+            return self._put_array(sess, load_array(raw, writable=False))
 
         if op == "put_abort":
             sess.staging.pop(int(req["staging"]), None)
@@ -431,7 +435,9 @@ class ChipProxy:
                     raise ValueError(f"bad slice [{off}, +{length})")
                 if off + length >= len(blob):
                     sess.fetch_cache = None
-                state["reply_blob"] = blob[off:off + length]
+                # memoryview: a bytes slice would copy the whole chunk a
+                # second time (send_msg writes buffers as-is)
+                state["reply_blob"] = memoryview(blob)[off:off + length]
                 return {"ok": True, "total": len(blob)}
             if int(buf.nbytes) > protocol.MAX_FRAME - 4096:
                 # An over-frame reply would raise in the server's *send*
