@@ -321,20 +321,27 @@ def test_attach_static_argnums_cached_separately(proxy):
         attach.detach()
 
 
+def _attach_env(proxy, pod_name, mode=""):
+    """The injected zero-touch contract, shared by every subprocess
+    attach test — one place to evolve when the contract grows."""
+    extra = {
+        C.ENV_CHIP_PROXY_PORT: str(proxy.port),
+        C.ENV_POD_NAME: pod_name,
+        C.ENV_TPU_REQUEST: "0.5",
+        C.ENV_TPU_LIMIT: "1.0",
+    }
+    if mode:
+        extra[C.ENV_ATTACH_MODE] = mode
+    return dict(os.environ,
+                PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
+                **extra)
+
+
 def test_unmodified_mnist_runs_through_proxy_subprocess(proxy):
     """THE zero-touch contract: `python -m kubeshare_tpu.models.mnist`
     with only env vars set (sitecustomize shim on PYTHONPATH) trains
     through the chip proxy — no source change anywhere."""
-    env = dict(
-        os.environ,
-        PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
-        **{
-            C.ENV_CHIP_PROXY_PORT: str(proxy.port),
-            C.ENV_POD_NAME: "mnist-pod",
-            C.ENV_TPU_REQUEST: "0.5",
-            C.ENV_TPU_LIMIT: "1.0",
-        },
-    )
+    env = _attach_env(proxy, "mnist-pod")
     proc = subprocess.run(
         [sys.executable, "-m", "kubeshare_tpu.models.mnist", "--steps", "3"],
         capture_output=True, text=True, env=env, timeout=300, cwd=str(REPO))
@@ -390,17 +397,7 @@ final = float(loss)
 print("first", first, "final", final)
 assert final < first * 0.5, (first, final)
 """)
-    env = dict(
-        os.environ,
-        PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
-        **{
-            C.ENV_CHIP_PROXY_PORT: str(proxy.port),
-            C.ENV_ATTACH_MODE: "proxy",   # forced: fail rather than local
-            C.ENV_POD_NAME: "haiku-pod",
-            C.ENV_TPU_REQUEST: "0.5",
-            C.ENV_TPU_LIMIT: "1.0",
-        },
-    )
+    env = _attach_env(proxy, "haiku-pod", mode="proxy")
     proc = subprocess.run([sys.executable, str(script)],
                           capture_output=True, text=True, env=env,
                           timeout=300, cwd=str(REPO))
@@ -408,6 +405,30 @@ assert final < first * 0.5, (first, final)
     assert "final" in proc.stdout
     assert proxy.total_execs >= 30   # every step ran ON the proxy
     assert "haiku-pod" not in proxy._sessions
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_through_proxy_attach(proxy, tmp_path):
+    """The long-training user journey under fractional sharing: an
+    unmodified workload checkpoints and crash-resumes while its params
+    live on the proxy as remote handles (Orbax materializes them through
+    __array__). The resumed run must do only the REMAINING steps."""
+    env = _attach_env(proxy, "ckpt-pod", mode="proxy")
+    ckpt = str(tmp_path / "ckpt")
+    r1 = subprocess.run(
+        [sys.executable, "-m", "kubeshare_tpu.models.mnist", "--steps", "4",
+         "--checkpoint", ckpt, "--checkpoint-every", "2"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=str(REPO))
+    assert r1.returncode == 0, (r1.stdout + r1.stderr)[-3000:]
+    # anchored: a bare "4 steps" would also match inside "12.34 steps/s"
+    assert "mnist: 4 steps in" in r1.stdout, r1.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "kubeshare_tpu.models.mnist", "--steps", "8",
+         "--checkpoint", ckpt, "--checkpoint-every", "2"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=str(REPO))
+    assert r2.returncode == 0, (r2.stdout + r2.stderr)[-3000:]
+    # restored at step 4 → only the remaining 4 of 8 run
+    assert "mnist: 4 steps in" in r2.stdout, r2.stdout
 
 
 def test_shim_fails_closed_when_attach_requested_but_unreachable():
