@@ -18,11 +18,16 @@ REPO = Path(__file__).resolve().parent.parent
 SHIM = REPO / "kubeshare_tpu" / "_shim"
 
 
-@pytest.fixture
-def proxy():
+def _make_proxy():
     p = ChipProxy(scheduler=TokenScheduler(window_ms=500, base_quota_ms=30,
                                            min_quota_ms=5))
     p.serve()
+    return p
+
+
+@pytest.fixture
+def proxy():
+    p = _make_proxy()
     yield p
     p.close()
 
@@ -405,6 +410,62 @@ assert final < first * 0.5, (first, final)
     assert "final" in proc.stdout
     assert proxy.total_execs >= 30   # every step ran ON the proxy
     assert "haiku-pod" not in proxy._sessions
+
+
+@pytest.mark.slow
+def test_proxy_death_kills_workload_fast_no_hang():
+    """When the chip proxy dies mid-training (launcherd will respawn it),
+    the attached workload must fail FAST with a clear error — never hang
+    on a dead socket. Crash → restart → checkpoint-resume is the
+    recovery journey; this pins its first leg."""
+    import time
+
+    p = _make_proxy()
+    env = _attach_env(p, "doomed-pod", mode="proxy")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeshare_tpu.models.mnist",
+         "--steps", "100000"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(REPO))
+    try:
+        time.sleep(15)                 # mid-compile or mid-loop
+        assert proc.poll() is None, proc.stdout.read()[-2000:]
+        t0 = time.monotonic()
+        p.close()                      # the proxy dies under the workload
+        out, _ = proc.communicate(timeout=90)
+        elapsed = time.monotonic() - t0
+        assert proc.returncode != 0, out[-2000:]
+        assert elapsed < 60, f"workload lingered {elapsed:.0f}s on a " \
+                             f"dead proxy"
+    finally:
+        p.close()                      # idempotent; covers early asserts
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+def test_gate_mode_manager_death_fails_fast():
+    """Gate-mode twin: the pod manager dying mid-run must surface as a
+    prompt error at the next gated call, not a hang."""
+    import jax
+
+    from kubeshare_tpu import attach
+
+    sched = TokenScheduler(window_ms=500, base_quota_ms=30, min_quota_ms=5)
+    server = serve(sched)
+    attach.attach_gate("127.0.0.1", server.server_address[1],
+                       "orphan", 0.5, 1.0)
+    try:
+        f = jax.jit(lambda x: x * 2.0)
+        assert float(f(np.float32(21.0))) == 42.0
+        server.shutdown()
+        server.server_close()
+        sched.close()
+        with pytest.raises((RuntimeError, OSError)):
+            for _ in range(200):       # at most until the quota forces a
+                f(np.float32(1.0))     # renew against the dead manager
+    finally:
+        attach.detach()
 
 
 @pytest.mark.slow
