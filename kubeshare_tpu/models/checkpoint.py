@@ -31,17 +31,74 @@ def _materialize(tree):
     return jax.tree_util.tree_map(leaf, tree)
 
 
+def verify_shared_path(path: str | os.PathLike) -> None:
+    """Fail FAST when a gang's checkpoint path is not on shared storage.
+
+    Every member must see the same directory or the saved checkpoint is
+    missing shards (and a later restore can deadlock on Orbax's
+    collective barrier when only some ranks find the directory). Rank 0
+    writes a run-unique token next to the checkpoint dir; after a global
+    barrier every rank must read that exact token — a pod-local
+    emptyDir yields a missing or stale probe and a clean SystemExit
+    instead of an unrestorable checkpoint."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    token = int(multihost_utils.broadcast_one_to_all(
+        np.random.default_rng().integers(1, 2**62, dtype=np.int64)))
+    path = os.path.abspath(os.fspath(path))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    probe = path + ".shared-probe"
+    if jax.process_index() == 0:
+        with open(probe, "w") as f:
+            f.write(str(token))
+    multihost_utils.sync_global_devices("kubeshare-ckpt-shared-probe")
+    try:
+        with open(probe) as f:
+            seen = int(f.read().strip() or 0)
+    except (FileNotFoundError, ValueError):
+        seen = -1
+    # Exchange verdicts BEFORE raising: if only the failing rank exited,
+    # the others would sail into the next collective and hang on its
+    # corpse — every rank must die together, each with the message.
+    verdicts = multihost_utils.process_allgather(
+        np.asarray(seen == token))
+    if not bool(np.all(verdicts)):
+        bad = [i for i, v in enumerate(np.atleast_1d(verdicts)) if not v]
+        raise SystemExit(
+            f"kubeshare-tpu: checkpoint path {path!r} is NOT shared "
+            f"storage (process(es) {bad} cannot see rank 0's probe) — a "
+            f"gang checkpoint there would be missing shards. Mount a "
+            f"shared volume (RWX) or drop --checkpoint.")
+
+
 def save_checkpoint(path: str | os.PathLike, params, opt_state,
                     step: int) -> None:
-    """Atomic full-state save (Orbax writes to a tmp dir and renames)."""
+    """Atomic full-state save (Orbax writes to a tmp dir and renames).
+
+    In a GANG (``jax.process_count() > 1``) the sharded ``jax.Array``
+    leaves are handed to Orbax as-is: every process writes its own
+    shards into the SAME directory and Orbax barriers the commit — the
+    path must therefore live on storage all gang members share (the
+    multihost contract every Orbax user has; a pod-local emptyDir would
+    persist only one member's shards)."""
     import orbax.checkpoint as ocp
 
-    leaves = [np.asarray(x) if hasattr(x, "fetch") else x
-              for x in jax.tree_util.tree_leaves(
-                  _materialize((params, opt_state)))]
+    if jax.process_count() > 1:
+        leaves = [x if isinstance(x, jax.Array) else np.asarray(x)
+                  for x in jax.tree_util.tree_leaves((params, opt_state))]
+        # step rides as a 0-d array (construct_restore_args has no
+        # handler for python/numpy scalars on the restore side)
+        step_leaf = np.asarray(int(step), np.int64)
+    else:
+        leaves = [np.asarray(x) if hasattr(x, "fetch") else x
+                  for x in jax.tree_util.tree_leaves(
+                      _materialize((params, opt_state)))]
+        step_leaf = int(step)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(os.path.abspath(os.fspath(path)),
-                   {"leaves": leaves, "step": int(step)}, force=True)
+                   {"leaves": leaves, "step": step_leaf}, force=True)
 
 
 def load_checkpoint(path: str | os.PathLike, like_params, like_opt_state):
@@ -49,16 +106,30 @@ def load_checkpoint(path: str | os.PathLike, like_params, like_opt_state):
 
     ``like_*`` provide the pytree STRUCTURE to restore into — pass a
     freshly built ``init()``/``optimizer.init()`` pair; their leaf values
-    are discarded. Raises FileNotFoundError when no checkpoint exists
-    (caller starts fresh).
+    are discarded (in a gang their SHARDINGS are kept: each process
+    restores exactly its own shards). Raises FileNotFoundError when no
+    checkpoint exists (caller starts fresh).
     """
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(os.fspath(path))
     if not os.path.isdir(path):
         raise FileNotFoundError(path)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        state = ckptr.restore(path)
+    like_leaves = jax.tree_util.tree_leaves((like_params, like_opt_state))
+    if jax.process_count() > 1:
+        def abstract(x):
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=x.sharding)
+            return np.asarray(x)
+        template = {"leaves": [abstract(x) for x in like_leaves],
+                    "step": np.zeros((), np.int64)}
+        restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            state = ckptr.restore(path, restore_args=restore_args)
+    else:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            state = ckptr.restore(path)
     treedef = jax.tree_util.tree_structure((like_params, like_opt_state))
     leaves = [state["leaves"][i] for i in range(len(state["leaves"]))] \
         if isinstance(state["leaves"], dict) else list(state["leaves"])

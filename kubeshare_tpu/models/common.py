@@ -19,6 +19,10 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..utils.logger import get_logger
+
+log = get_logger("models")
+
 
 @dataclass
 class TrainResult:
@@ -101,14 +105,13 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
         mesh = gang_mesh()
 
     if checkpoint and jax.process_count() > 1:
-        # Orbax multihost save needs one SHARED directory + barrier'd
-        # commit; a pod-local path would persist only the local shards.
-        # Refuse loudly rather than write an unrestorable checkpoint.
-        # (Single-process sharded runs checkpoint fine — every shard is
-        # process-addressable.)
-        raise ValueError("checkpointing is not supported in multi-process "
-                         "gang runs yet — drop --checkpoint or train "
-                         "single-process")
+        # Orbax multihost: every member writes its shards into the SAME
+        # directory and the commit is barrier'd. Verify the path really
+        # is shared BEFORE touching it — a pod-local path would produce
+        # an unrestorable checkpoint (or a restore deadlock when only
+        # some ranks find the directory).
+        from .checkpoint import verify_shared_path
+        verify_shared_path(checkpoint)
 
     key = jax.random.PRNGKey(seed)
     pkey, bkey = jax.random.split(key)
@@ -133,6 +136,13 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
     else:
         step = make_train_step(loss_fn, optimizer)
     opt_state = optimizer.init(params)
+    if mesh is not None:
+        # Explicit mesh placement for the optimizer state too: adam's
+        # scalars (count) are otherwise born uncommitted on one device,
+        # and a gang checkpoint restore would pin them there — colliding
+        # with the mesh-placed params inside the jitted step.
+        opt_state = jax.device_put(opt_state,
+                                   param_sharding(mesh, opt_state))
 
     done = 0
     if checkpoint:
@@ -142,6 +152,12 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
                                                       opt_state)
         except FileNotFoundError:
             pass
+        if done:
+            # Resume continues the SAME trajectory: warmup steps would
+            # apply real optimizer updates beyond the recorded step (and
+            # a nothing-to-do restart would silently drift the model).
+            # The first timed step absorbs the compile instead.
+            warmup = 0
 
     loss = jnp.zeros(())
     for _ in range(warmup):
@@ -168,7 +184,12 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
                     and i % checkpoint_every == 0):
                 save_checkpoint(checkpoint, params, opt_state, done + i)
     elapsed = time.perf_counter() - start
-    if checkpoint:
+    if checkpoint and remaining and not (
+            checkpoint_every and remaining % checkpoint_every == 0):
+        # Final save only when the loop's last in-loop save didn't already
+        # cover this exact step — a duplicate save is a full barrier'd
+        # checkpoint rewrite in a gang. remaining == 0 saves nothing: the
+        # on-disk state already IS this state.
         save_checkpoint(checkpoint, params, opt_state, done + remaining)
     return TrainResult(steps=remaining, seconds=elapsed,
                        final_loss=float(loss))

@@ -237,10 +237,23 @@ def test_two_process_gang_trains_one_model_zero_touch():
     """The manifest contract end-to-end: two UNMODIFIED model CLI
     processes + gang env (+ shim on PYTHONPATH) join one jax.distributed
     runtime and train ONE data-parallel model — identical losses."""
-    port = free_port()
+    outs = _gang_run(2, free_port(), group="cli-gang")
+    losses = [l.split("final loss")[-1].strip()
+              for out in outs for l in out.splitlines() if "final loss" in l]
+    assert len(losses) == 2 and losses[0] == losses[1], losses
+
+
+def _gang_run(steps, port, ckpt=None, group="gang", expect_rc=0):
+    """Two mnist CLI processes as one gang; returns their outputs.
+    ``ckpt`` may be a path or a callable(rank) -> path (to simulate
+    pod-local, non-shared storage)."""
     shim = REPO / "kubeshare_tpu" / "_shim"
     procs = []
     for rank in range(2):
+        args = ["--steps", str(steps), "--platform", "cpu"]
+        if ckpt is not None:
+            path = ckpt(rank) if callable(ckpt) else ckpt
+            args += ["--checkpoint", path, "--checkpoint-every", "2"]
         env = dict(
             os.environ,
             PYTHONPATH=os.pathsep.join([str(shim), str(REPO)]),
@@ -249,22 +262,51 @@ def test_two_process_gang_trains_one_model_zero_touch():
                 C.ENV_COORDINATOR: f"127.0.0.1:{port}",
                 C.ENV_NUM_PROCESSES: "2",
                 C.ENV_PROCESS_ID: str(rank),
-                C.ENV_GROUP_NAME: "cli-gang",
+                C.ENV_GROUP_NAME: group,
             },
         )
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", "kubeshare_tpu.models.mnist",
-             "--steps", "2", "--platform", "cpu"],
+            [sys.executable, "-m", "kubeshare_tpu.models.mnist", *args],
             env=env, cwd=str(REPO), stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=240)
-        assert p.returncode == 0, out[-2000:]
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == expect_rc, out[-3000:]
         outs.append(out)
+    return outs
+
+
+def test_gang_checkpoint_save_and_resume(tmp_path):
+    """Multi-process gang checkpointing: every member writes its shards
+    of the SHARDED state into one shared directory (Orbax barriers the
+    commit); a fresh 2-process gang restores and does only the REMAINING
+    steps. The reference has no checkpoint story at all (SURVEY §5)."""
+    ckpt = str(tmp_path / "gang-ck")
+    outs = _gang_run(4, free_port(), ckpt=ckpt, group="ckpt-gang")
+    for out in outs:
+        assert "mnist: 4 steps in" in out, out[-1500:]
+    # a NEW gang (fresh coordinator) restores at step 4 → 4 of 8 remain
+    outs = _gang_run(8, free_port(), ckpt=ckpt, group="ckpt-gang")
+    for out in outs:
+        assert "mnist: 4 steps in" in out, out[-1500:]
     losses = [l.split("final loss")[-1].strip()
-              for out in outs for l in out.splitlines() if "final loss" in l]
+              for out in outs for l in out.splitlines()
+              if "final loss" in l]
     assert len(losses) == 2 and losses[0] == losses[1], losses
+
+
+def test_gang_checkpoint_on_unshared_path_fails_every_rank_fast(tmp_path):
+    """A pod-local (non-shared) checkpoint path must kill EVERY gang
+    member promptly with an actionable message — not write a checkpoint
+    missing shards, and not hang the surviving ranks at the next
+    collective."""
+    outs = _gang_run(
+        2, free_port(),
+        ckpt=lambda rank: str(tmp_path / f"rank-local-{rank}" / "ck"),
+        group="unshared-gang", expect_rc=1)
+    for out in outs:
+        assert "NOT shared storage" in out, out[-1500:]
 
 
 def test_gang_cli_long_context_ring_attention():
