@@ -56,6 +56,50 @@ def random_labels(rng, i):
             C.POD_GROUP_HEADCOUNT: "2", C.POD_GROUP_THRESHOLD: "1.0"}
 
 
+@pytest.mark.parametrize("seed", [10, 11, 12])
+def test_crash_restart_resync_reconstructs_exact_bookings(seed):
+    """The crash-recovery property, fuzzed: at any point in a random
+    churn, a FRESH engine rebuilt purely from the bound pods' labels +
+    write-back annotations (the reference's informer resync,
+    pod.go:528-582) must arrive at exactly the old engine's per-leaf
+    bookkeeping — no state beyond the pod objects is ever needed."""
+    rng = random.Random(seed)
+    eng = make_engine()
+    live: dict[str, tuple[dict, dict]] = {}   # key -> (labels, annotations)
+    for i in range(120):
+        if rng.random() < 0.6 or not live:
+            labels = random_labels(rng, i)
+            pod = eng.submit("ns", f"c-{i}", labels)
+            try:
+                binding = eng.schedule(pod)
+                live[pod.key] = (labels, binding.annotations)
+            except Unschedulable:
+                eng.delete_pod(pod.key)
+        else:
+            key = rng.choice(sorted(live))
+            del live[key]
+            eng.delete_pod(key)
+        if i % 30 != 29:
+            continue
+        # crash: a fresh engine resyncs every bound pod from its pod
+        # object alone and must match the old engine leaf for leaf
+        fresh = make_engine()
+        for key, (labels, ann) in live.items():
+            ns, _, name = key.partition("/")
+            pod = eng.pod_status[key]
+            fresh.resync_bound(ns, name, labels, ann, pod.node_name,
+                               uid=pod.uid)
+        for chip_id, leaf in eng.leaf_cells.items():
+            fleaf = fresh.leaf_cells[chip_id]
+            assert fleaf.available == pytest.approx(leaf.available), \
+                f"{chip_id}: {fleaf.available} != {leaf.available}"
+            assert fleaf.free_memory == leaf.free_memory, chip_id
+        # ranks survive the restart
+        for key in live:
+            assert (fresh.pod_status[key].group_rank
+                    == eng.pod_status[key].group_rank), key
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_engine_survives_random_churn(seed):
     rng = random.Random(seed)
