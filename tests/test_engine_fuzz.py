@@ -100,6 +100,54 @@ def test_crash_restart_resync_reconstructs_exact_bookings(seed):
                     == eng.pod_status[key].group_rank), key
 
 
+@pytest.mark.parametrize("seed", [20, 21, 22])
+def test_dispatcher_survives_random_churn_virtual_time(seed):
+    """The ENFORCING loop under churn, in virtual time: random submits
+    (incl. gangs that will park, fill, or time out), deletes of pods in
+    every state, and time jumps that fire gang timeouts and GC. The
+    cell-tree invariants must hold after every step, and draining
+    everything must leave the fleet exactly fresh."""
+    from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+
+    rng = random.Random(seed)
+    now = [0.0]
+    eng = SchedulerEngine(clock=lambda: now[0])
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=2, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in sorted(by_host.items()):
+        eng.add_node(host, chips)
+    disp = Dispatcher(eng, clock=lambda: now[0])
+    submitted: list[str] = []
+    for i in range(300):
+        op = rng.random()
+        if op < 0.5:
+            labels = random_labels(rng, i)
+            if rng.random() < 0.3:      # some gangs never fill → timeout
+                labels[C.POD_GROUP_NAME] = f"lone{i}"
+                labels[C.POD_GROUP_HEADCOUNT] = "3"
+                labels[C.POD_GROUP_THRESHOLD] = "1.0"
+                labels.setdefault(C.POD_TPU_REQUEST, "1")
+                labels.setdefault(C.POD_TPU_LIMIT, "1")
+                labels[C.POD_PRIORITY] = "10"
+            submitted.append(disp.submit("ns", f"d-{i}", labels))
+        elif op < 0.8 and submitted:
+            disp.delete(submitted.pop(rng.randrange(len(submitted))))
+        else:
+            now[0] += rng.uniform(0.5, 40.0)   # timeouts + GC fire
+        disp.step(now[0])
+        check_invariants(eng)
+    for key in submitted:
+        disp.delete(key)
+    now[0] += 1000.0
+    disp.step(now[0])
+    for leaf in eng.leaf_cells.values():
+        assert leaf.available == leaf.leaf_cell_number, leaf.chip_id
+        assert leaf.free_memory == leaf.full_memory, leaf.chip_id
+    for node, ports in eng.ports.items():
+        assert ports.count() == 1, f"{node} leaked manager ports"
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_engine_survives_random_churn(seed):
     rng = random.Random(seed)
