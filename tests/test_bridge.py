@@ -265,6 +265,49 @@ def test_bridge_relist_reclaims_pod_deleted_during_watch_gap():
         api.close()
 
 
+def test_bridge_converges_under_random_flapping(seedless_rng=None):
+    """Interleaving coverage for the control loop: pods appear, get
+    deleted WITH or WITHOUT a delivered event (watch gaps), and the
+    bridge relists at random points — afterwards the engine must track
+    exactly the API server's live set, every booking reclaimed for the
+    vanished."""
+    import random
+
+    rng = random.Random(7)
+    api = FakeKubeAPI()
+    eng, svc = make_service()
+    try:
+        bridge = make_bridge(api, svc)
+        n = 0
+        for round_ in range(30):
+            op = rng.random()
+            if op < 0.5:
+                pod = make_pod(f"f-{n}", labels={
+                    C.POD_TPU_REQUEST: rng.choice(["0.3", "0.5", "1"]),
+                    C.POD_TPU_LIMIT: "1.0"})
+                n += 1
+                key = api.add_pod(pod)
+                if rng.random() < 0.7:
+                    bridge.handle("ADDED", pod)   # event delivered
+            elif api.pods:
+                key = rng.choice(sorted(api.pods))
+                pod = api.pods.pop(key)
+                if rng.random() < 0.5:
+                    bridge.handle("DELETED", pod)  # else: watch gap
+            if rng.random() < 0.4:
+                bridge.sync_once()                 # reconnect relist
+        bridge.sync_once()                         # final convergence
+        live = set(api.pods)
+        assert set(eng.pod_status) == live, (set(eng.pod_status), live)
+        booked = sum(leaf.leaf_cell_number - leaf.available
+                     for leaf in eng.leaf_cells.values())
+        expected = sum(eng.pod_status[k].request for k in live)
+        assert abs(booked - expected) < 1e-9, (booked, expected)
+    finally:
+        svc.close()
+        api.close()
+
+
 def test_bridge_writes_back_gang_member_bound_after_202():
     """A gang member parked at the Permit barrier generates no pod event
     when the dispatcher later binds it — the poller must write it back."""
