@@ -156,3 +156,40 @@ def test_journal_tolerates_torn_tail(tmp_path):
     r3 = TelemetryRegistry(journal=j)
     assert "ns/r" in r3.pods()
     r3.close()
+
+
+def test_journal_replay_equivalence_fuzzed(tmp_path):
+    """Durability property over interleavings: after ANY random sequence
+    of capacity puts/drops, pod puts/withdrawals, restarts (replay), and
+    the compactions they trigger, a freshly replayed registry must equal
+    the live one exactly."""
+    import random
+
+    rng = random.Random(3)
+    j = str(tmp_path / "journal.jsonl")
+    reg = TelemetryRegistry(journal=j)
+    for i in range(400):
+        op = rng.random()
+        if op < 0.3:
+            node = f"n{rng.randrange(4)}"
+            reg.put_capacity(node, [{"chip_id": f"{node}-c{k}",
+                                     "model": "TPU-v4"}
+                                    for k in range(rng.randrange(1, 4))])
+        elif op < 0.4:
+            reg.drop_capacity(f"n{rng.randrange(4)}")
+        elif op < 0.75:
+            reg.put_pod(f"ns/p{rng.randrange(30)}",
+                        {"node": f"n{rng.randrange(4)}",
+                         "request": rng.choice([0.3, 0.5, 1.0]),
+                         "chip_id": f"c{rng.randrange(8)}"})
+        elif op < 0.95:
+            reg.drop_pod(f"ns/p{rng.randrange(30)}")
+        else:
+            # restart: replay must reconstruct the exact state
+            replayed = TelemetryRegistry(journal=j)
+            assert replayed.capacity() == reg.capacity(), i
+            assert replayed.pods() == reg.pods(), i
+            reg = replayed              # continue on the replayed instance
+    final = TelemetryRegistry(journal=j)
+    assert final.capacity() == reg.capacity()
+    assert final.pods() == reg.pods()
