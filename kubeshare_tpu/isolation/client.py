@@ -26,7 +26,7 @@ import numpy as np
 
 from ..utils.logger import get_logger
 from . import protocol
-from .protocol import dump_array, load_array
+from .protocol import load_array
 
 log = get_logger("client")
 
@@ -172,22 +172,26 @@ class ProxyClient:
 
     def put(self, array) -> RemoteBuffer:
         arr = np.asarray(array)
-        blob = dump_array(arr)
-        view = memoryview(blob)   # zero-copy slicing for the chunked path
+        # parts = [npy header, flat data view]: the payload crosses the
+        # socket straight from the array's memory — zero host copies on
+        # this side (protocol.dump_array_parts)
+        parts = protocol.dump_array_parts(arr)
+        nbytes = sum(memoryview(p).nbytes for p in parts)
         chunk = self._chunk()
-        if len(blob) <= chunk:
+        if nbytes <= chunk:
             reply, _ = self._conn.call({"op": "put", "name": self.name},
-                                       blob=blob)
+                                       blob=parts)
         else:
             reply0, _ = self._conn.call({"op": "put_begin",
                                          "name": self.name,
-                                         "nbytes": len(blob)})
+                                         "nbytes": nbytes})
             sid = reply0["staging"]
             try:
-                for off in range(0, len(blob), chunk):
-                    self._conn.call({"op": "put_chunk", "name": self.name,
-                                     "staging": sid, "offset": off},
-                                    blob=view[off:off + chunk])
+                for off in range(0, nbytes, chunk):
+                    self._conn.call(
+                        {"op": "put_chunk", "name": self.name,
+                         "staging": sid, "offset": off},
+                        blob=protocol.slice_buffers(parts, off, chunk))
                 reply, _ = self._conn.call({"op": "put_commit",
                                             "name": self.name,
                                             "staging": sid})

@@ -178,6 +178,7 @@ def main(argv=None) -> None:
     # refusal is permanent and fails fast.
     mgr = None
     last: Exception | None = None
+    last_was_refusal = False
     for attempt in range(40):
         try:
             mgr = PodManager(args.scheduler_ip, args.scheduler_port,
@@ -186,12 +187,22 @@ def main(argv=None) -> None:
             break
         except OSError as exc:
             last = exc
+            last_was_refusal = False
         except RuntimeError as exc:   # scheduler ANSWERED with a refusal
             if "duplicate client" not in str(exc):
                 raise SystemExit(f"register failed: {exc}")
             last = exc
+            last_was_refusal = True
         time.sleep(0.25)
     if mgr is None:
+        # Distinguish a persistent refusal from an unreachable address
+        # (the native relay's last_refusal branch): pointing the operator
+        # at network debugging when the scheduler answered every attempt
+        # misdirects the diagnosis.
+        if last_was_refusal:
+            raise SystemExit(
+                f"scheduler at {args.scheduler_ip}:{args.scheduler_port} "
+                f"kept refusing registration: {last}")
         raise SystemExit(
             f"cannot reach scheduler at {args.scheduler_ip}:"
             f"{args.scheduler_port}: {last}")

@@ -21,12 +21,15 @@ _HDR = struct.Struct(">I")
 MAX_FRAME = 1 << 30
 
 
-def dump_array(arr) -> bytes:
-    """numpy array → .npy bytes (the blob format for device buffers).
+def dump_array_parts(arr) -> list:
+    """numpy array → ``[npy header bytes, raw data buffer]``.
 
-    Hand-assembled from the npy header + the raw data (one copy) instead
-    of ``np.save`` into a growing BytesIO (several copies) — for a
-    64 MiB buffer this alone is ~2x. Wire format is unchanged."""
+    The parts are sent as separate ``sendall`` buffers (``send_msg``
+    accepts a list), so the payload is never copied when the input is
+    already C-contiguous — the data buffer is a flat memoryview straight
+    over the array. ``np.save`` into a growing BytesIO costs several full
+    copies; for a 64 MiB buffer this path is the difference between
+    memcpy-bound and syscall-bound. Wire format is plain .npy."""
     import numpy as np
     # order="C" (NOT ascontiguousarray, which promotes 0-d scalars to
     # shape-(1,)) — copies only when the input isn't already C-ordered
@@ -38,7 +41,36 @@ def dump_array(arr) -> bytes:
     hdr = io.BytesIO()  # write_array_header_* emits magic+version itself
     np.lib.format.write_array_header_2_0(
         hdr, np.lib.format.header_data_from_array_1_0(arr))
-    return b"".join([hdr.getvalue(), arr.tobytes()])
+    # cast("B") rejects zero-sized views; an empty payload is just b""
+    data = memoryview(arr).cast("B") if arr.nbytes else b""
+    return [hdr.getvalue(), data]
+
+
+def dump_array(arr) -> bytes:
+    """numpy array → .npy bytes in ONE contiguous buffer (one payload
+    copy — the join). Use :func:`dump_array_parts` on send paths; this
+    form is for callers that need random byte access (slice caches)."""
+    return b"".join(dump_array_parts(arr))
+
+
+def slice_buffers(parts, offset: int, length: int) -> list:
+    """Byte-range ``[offset, offset+length)`` over a logical stream of
+    buffers, without materializing the stream — the chunked-put path
+    slices header+payload as if they were one blob."""
+    out = []
+    for p in parts:
+        mv = memoryview(p)
+        n = mv.nbytes
+        if offset >= n:
+            offset -= n
+            continue
+        take = min(length, n - offset)
+        out.append(mv[offset:offset + take])
+        length -= take
+        offset = 0
+        if length <= 0:
+            break
+    return out
 
 
 def load_array(blob, writable: bool = True):
@@ -98,14 +130,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def send_msg(sock: socket.socket, msg: dict, blob=None) -> None:
-    """``blob`` may be bytes or any buffer (memoryview) — sent as-is
-    after the JSON frame, never concatenated (a header+blob join would
-    copy the whole payload). Length accounting is BYTES (``nbytes``),
-    never element count — a non-byte memoryview would otherwise desync
-    the framing."""
+    """``blob`` may be bytes, any buffer (memoryview), or a LIST of
+    buffers (``dump_array_parts`` output) — each sent as-is after the
+    JSON frame, never concatenated (a join would copy the whole
+    payload). Length accounting is BYTES (``nbytes``), never element
+    count — a non-byte memoryview would otherwise desync the framing."""
+    parts: list = []
     nblob = 0
     if blob is not None:
-        nblob = memoryview(blob).nbytes
+        parts = list(blob) if isinstance(blob, (list, tuple)) else [blob]
+        nblob = sum(memoryview(p).nbytes for p in parts)
         if nblob > MAX_FRAME:
             raise FrameTooLarge(f"blob too large: {nblob}")
         msg = dict(msg, _blob=nblob)
@@ -113,8 +147,9 @@ def send_msg(sock: socket.socket, msg: dict, blob=None) -> None:
     if len(data) > MAX_FRAME:
         raise FrameTooLarge(f"frame too large: {len(data)}")
     sock.sendall(_HDR.pack(len(data)) + data)
-    if nblob:
-        sock.sendall(blob)
+    for p in parts:
+        if memoryview(p).nbytes:
+            sock.sendall(p)
 
 
 def recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:

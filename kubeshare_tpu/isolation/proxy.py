@@ -448,7 +448,9 @@ class ChipProxy:
                     f"buffer too large to transfer ({int(buf.nbytes)} bytes);"
                     " fetch it in slices (get with offset/length)")
             with self._dlock:
-                state["reply_blob"] = dump_array(buf)
+                # parts: device→host copy (np.asarray) is the only copy;
+                # the reply payload streams straight from that buffer
+                state["reply_blob"] = protocol.dump_array_parts(buf)
             return {"ok": True}
 
         if op == "free":

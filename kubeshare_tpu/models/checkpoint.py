@@ -18,6 +18,7 @@ on save.
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -54,16 +55,32 @@ def verify_shared_path(path: str | os.PathLike) -> None:
         with open(probe, "w") as f:
             f.write(str(token))
     multihost_utils.sync_global_devices("kubeshare-ckpt-shared-probe")
-    try:
-        with open(probe) as f:
-            seen = int(f.read().strip() or 0)
-    except (FileNotFoundError, ValueError):
-        seen = -1
+    # The barrier orders execution, not filesystem visibility: NFS-style
+    # mounts cache attributes/directories, so a just-created file can
+    # take seconds to appear on other ranks. Poll before declaring the
+    # path unshared — a spurious gang-wide abort is worse than a few
+    # seconds of startup latency.
+    deadline = time.monotonic() + 10.0
+    seen = -1
+    while True:
+        try:
+            with open(probe) as f:
+                seen = int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            seen = -1
+        if seen == token or time.monotonic() >= deadline:
+            break
+        time.sleep(0.25)
     # Exchange verdicts BEFORE raising: if only the failing rank exited,
     # the others would sail into the next collective and hang on its
     # corpse — every rank must die together, each with the message.
     verdicts = multihost_utils.process_allgather(
         np.asarray(seen == token))
+    if jax.process_index() == 0:
+        try:
+            os.remove(probe)
+        except OSError:
+            pass
     if not bool(np.all(verdicts)):
         bad = [i for i, v in enumerate(np.atleast_1d(verdicts)) if not v]
         raise SystemExit(

@@ -314,13 +314,26 @@ class PodEventBridge:
         sync appears in the list but maybe not the snapshot (safe — not
         reaped), never the other way around.
         """
-        try:
-            code, st = self.service.state()
-            engine_pods = set(st.get("pods") or {}) if code == 200 else set()
-        except Exception as e:
-            log.warning("engine state fetch failed (skipping deletion "
-                        "reconcile): %s", e)
-            engine_pods = set()
+        engine_pods: set[str] | None = None
+        last_err: Exception | None = None
+        for attempt in range(3):
+            try:
+                code, st = self.service.state()
+                if code == 200:
+                    engine_pods = set(st.get("pods") or {})
+                    break
+                last_err = RuntimeError(f"/state returned {code}")
+            except Exception as e:
+                last_err = e
+            time.sleep(0.5 * (attempt + 1))
+        if engine_pods is None:
+            # Defer the whole relist rather than degrade: proceeding with
+            # an empty engine set would skip the deletion reconcile, and
+            # pods deleted during the watch gap would stay booked until
+            # the NEXT watch drop (the round-3 leak this path exists to
+            # close). The run() loop retries after reconnect_s.
+            raise RuntimeError(
+                f"engine state unavailable ({last_err}); deferring relist")
         items, version = self.kube.list_pods(self.scheduler_name)
         listed = set()
         for pod in items:

@@ -65,7 +65,13 @@ def _tile_host(coords: set[tuple[int, ...]], k: int,
     c0 = min(coords)
     for shape in block_shapes(k, mesh):
         for offsets in itertools.product(*[range(s) for s in shape]):
-            anchor = tuple((c - o) % m for c, o, m in zip(c0, offsets, mesh))
+            anchor = tuple(c - o for c, o in zip(c0, offsets))
+            # Non-wrapping only: the fleet bounding box is usually a
+            # SUB-slice with no physical wraparound links, so a block
+            # that wraps it would pair non-neighbour chips (ADVICE r4).
+            if any(a < 0 or a + s > m
+                   for a, s, m in zip(anchor, shape, mesh)):
+                continue
             block = _block_coords(anchor, shape, mesh)
             if any(c not in coords for c in block):
                 continue
@@ -92,7 +98,12 @@ def plan_gang(leaves: list[Cell], members: int,
         if len(free) < total:
             continue
         for shape in block_shapes(total, mesh):
-            for anchor in itertools.product(*[range(s) for s in mesh]):
+            # Non-wrapping anchors only (ADVICE r4): the derived
+            # bounding-box mesh has no physical wrap links unless the
+            # block spans the axis's full extent — and a full-extent
+            # block is exactly the anchor-0 non-wrapping placement.
+            for anchor in itertools.product(
+                    *[range(m - s + 1) for m, s in zip(mesh, shape)]):
                 coords = _block_coords(anchor, shape, mesh)
                 if any(c not in free for c in coords):
                     continue

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Generate the admission webhook's serving certs and wire them up:
+#   1. self-signed CA + serving cert/key for
+#      kubeshare-tpu-webhook.kube-system.svc (SAN-correct for the
+#      Service the MutatingWebhookConfiguration points at)
+#   2. kubectl: create/update the kubeshare-tpu-webhook-tls Secret
+#   3. kubectl: patch the caBundle into the webhook configuration
+# Without kubectl on PATH, steps 2-3 are printed instead of run.
+set -euo pipefail
+
+NS=${NS:-kube-system}
+SVC=${SVC:-kubeshare-tpu-webhook}
+OUT=${OUT:-$(mktemp -d)}
+DAYS=${DAYS:-3650}
+
+openssl req -x509 -newkey rsa:2048 -nodes -days "$DAYS" \
+  -keyout "$OUT/ca.key" -out "$OUT/ca.crt" \
+  -subj "/CN=kubeshare-tpu-webhook-ca" 2>/dev/null
+
+openssl req -newkey rsa:2048 -nodes \
+  -keyout "$OUT/tls.key" -out "$OUT/tls.csr" \
+  -subj "/CN=$SVC.$NS.svc" 2>/dev/null
+
+cat > "$OUT/san.cnf" <<EOF
+subjectAltName=DNS:$SVC,DNS:$SVC.$NS,DNS:$SVC.$NS.svc,DNS:$SVC.$NS.svc.cluster.local
+EOF
+
+openssl x509 -req -in "$OUT/tls.csr" -CA "$OUT/ca.crt" -CAkey "$OUT/ca.key" \
+  -CAcreateserial -days "$DAYS" -extfile "$OUT/san.cnf" \
+  -out "$OUT/tls.crt" 2>/dev/null
+
+CA_BUNDLE=$(base64 < "$OUT/ca.crt" | tr -d '\n')
+echo "certs in $OUT"
+
+if command -v kubectl >/dev/null 2>&1; then
+  kubectl -n "$NS" create secret tls "$SVC-tls" \
+    --cert="$OUT/tls.crt" --key="$OUT/tls.key" \
+    --dry-run=client -o yaml | kubectl apply -f -
+  kubectl patch mutatingwebhookconfiguration kubeshare-tpu-webhook \
+    --type=json -p "[{\"op\":\"replace\",\"path\":\"/webhooks/0/clientConfig/caBundle\",\"value\":\"$CA_BUNDLE\"}]" \
+    2>/dev/null || echo "webhook config not applied yet — caBundle below"
+else
+  echo "kubectl not found — apply by hand:"
+  echo "  kubectl -n $NS create secret tls $SVC-tls --cert=$OUT/tls.crt --key=$OUT/tls.key"
+fi
+echo "caBundle: $CA_BUNDLE"

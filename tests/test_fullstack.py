@@ -170,6 +170,91 @@ def test_full_stack_gate_mode_whole_chip_pod(tmp_path):
         api.close()
 
 
+def test_full_stack_labels_only_pod_via_webhook(tmp_path):
+    """The round-5 UX contract, end-to-end: the user writes LABELS ONLY
+    (no schedulerName, no env, no volumes — examples/pod-shared.yaml);
+    admission mutates the pod, the bridge schedules + annotates + binds,
+    the kubelet's downward-API resolution (resolve_downward_env) yields
+    the complete attach env from the pod object alone, and the unmodified
+    workload trains through the launcherd-spawned proxy
+    (≙ README.md:34-48 labels-only UX + shadow-pod injection,
+    scheduler.go:515-528)."""
+    import base64
+    import json as _json
+    from kubeshare_tpu.scheduler.webhook import (admission_response,
+                                                 apply_json_patch,
+                                                 resolve_downward_env)
+    node = "tpu-host-0"
+    chips = FakeTopology(hosts=1, mesh=(1,)).chips()
+    chip_ids = [c.chip_id for c in chips]
+
+    registry = TelemetryRegistry()
+    registry.put_capacity(node, [c.to_labels() for c in chips])
+    eng = SchedulerEngine()
+    svc = SchedulerService(eng, registry)
+    svc.serve()
+    api = FakeKubeAPI()
+    bridge = PodEventBridge(ServiceClient(f"http://127.0.0.1:{svc.port}"),
+                            KubeClient(api.url), scheduler_name=SCHED)
+    base = str(tmp_path)
+    configd = ConfigDaemon(registry, node, chip_ids, base_dir=base,
+                           period_s=0.05)
+    launcherd = LauncherDaemon(chip_ids, base_dir=base, poll_s=0.05,
+                               proxy_cmd=cpu_proxy_cmd)
+    exec_ports = exec_port_map(chip_ids)
+    try:
+        configd.start()
+        launcherd.start()
+        assert wait_for(lambda: chip_ids[0] in launcherd._proxies)
+
+        # L6: labels-only pod — strictly what examples/pod-shared.yaml
+        # carries. No schedulerName: admission supplies it.
+        pod = make_pod("labels-only", labels={
+            C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"})
+        del pod["spec"]["schedulerName"]
+        pod["spec"]["containers"] = [
+            {"name": "mnist", "image": "kubeshare-tpu:latest"}]
+
+        # admission: what the API server does with our webhook response
+        review = {"request": {"uid": "u", "kind": {"kind": "Pod"},
+                              "object": pod}}
+        resp = admission_response(review, scheduler_name=SCHED)["response"]
+        assert resp["allowed"]
+        patch = _json.loads(base64.b64decode(resp["patch"]))
+        key = api.add_pod(apply_json_patch(pod, patch))
+        bridge.sync_once()
+
+        pod = api.pods[key]
+        assert pod["spec"]["nodeName"] == node
+        assert pod["spec"]["schedulerName"] == SCHED
+        ann = pod["metadata"]["annotations"]
+        mkey = (chip_ids[0], key)
+        assert wait_for(lambda: mkey in launcherd._managers)
+
+        # the kubelet: resolve EVERY injected fieldRef from the bound pod
+        resolved = resolve_downward_env(pod, pod["spec"]["containers"][0])
+        assert resolved[C.ENV_POD_MANAGER_PORT] == ann[C.POD_MANAGER_PORT]
+        assert resolved[C.ENV_VISIBLE_CHIPS] == chip_ids[0]
+        env = dict(os.environ,
+                   PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
+                   **resolved,
+                   **{C.ENV_ATTACH_MODE: "proxy",   # node-local bits the
+                      C.ENV_CHIP_PROXY_PORT:        # launcher owns
+                      str(exec_ports[chip_ids[0]])})
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeshare_tpu.models.mnist",
+             "--steps", "3"],
+            capture_output=True, text=True, env=env, timeout=300,
+            cwd=str(REPO))
+        assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+        assert "final loss" in proc.stdout
+    finally:
+        launcherd.stop()
+        configd.stop()
+        svc.close()
+        api.close()
+
+
 def test_full_stack_pod_to_training(tmp_path):
     node = "tpu-host-0"
     chips = FakeTopology(hosts=1, mesh=(1,)).chips()

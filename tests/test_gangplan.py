@@ -228,3 +228,24 @@ def test_plan_slots_order_neighbouring_ranks():
         assert {c.node for c in cells} == {node}
         anchors.append(min(c.coords for c in cells))
     assert anchors == sorted(anchors)        # walk along the block
+
+
+def test_plan_never_wraps_the_bounding_box():
+    """ADVICE r4: the fleet bounding-box mesh has no physical wraparound
+    links, so a plan must never pair chips across the box edge. Free the
+    two ENDS of a 4x2 two-host slice (middle occupied): a wrapping
+    planner would call {ends} a contiguous 2x2x... block — the correct
+    answer is None."""
+    eng = make_engine(hosts=2, mesh=(2, 2))
+    # present only the x=0 and x=3 rows of the 4x2 global mesh as free:
+    # the bounding box still derives as 4x2 (max-min+1), and the two
+    # free rows touch only across the (non-existent) wrap link
+    from kubeshare_tpu.scheduler.gangplan import fleet_leaf_cells
+    leaves = fleet_leaf_cells(eng.free_list, eng.nodes, "TPU-v4")
+    ends = [leaf for leaf in leaves if leaf.coords[0] in (0, 3)]
+    assert len(ends) == 4
+    assert plan_gang(ends, 2, 2) is None
+    assert plan_gang(ends, 4, 1) is None
+    # sanity: the same shapes DO plan when the rows are ICI neighbours
+    mid = [leaf for leaf in leaves if leaf.coords[0] in (1, 2)]
+    assert plan_gang(mid, 4, 1) is not None

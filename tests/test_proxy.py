@@ -665,3 +665,41 @@ def test_idle_watchdog_races_gated_execution_stress():
         assert len(counts) == 4 and all(n > 10 for n in counts.values()), counts
     finally:
         p.close()
+
+
+def test_dump_array_parts_stream_equals_blob():
+    """parts = [header, flat data view] must byte-equal the contiguous
+    blob for every dtype/shape the wire carries, and slice_buffers must
+    reassemble any byte range without materializing the stream."""
+    import numpy as np
+    from kubeshare_tpu.isolation import protocol
+
+    for arr in (np.arange(23, dtype=np.float32).reshape(23, 1),
+                np.asarray(3.5, np.float64),          # 0-d scalar
+                np.arange(6, dtype=np.int8)[::2],     # non-contiguous
+                np.zeros((0, 4), np.float32)):        # empty
+        blob = protocol.dump_array(arr)
+        parts = protocol.dump_array_parts(arr)
+        assert b"".join(bytes(memoryview(p)) for p in parts) == blob
+        n = len(blob)
+        for off, length in ((0, n), (1, 7), (n - 3, 3), (5, n)):
+            if n == 0:
+                continue
+            got = b"".join(bytes(memoryview(p)) for p in
+                           protocol.slice_buffers(parts, off, length))
+            assert got == blob[off:off + length]
+        back = protocol.load_array(blob)
+        np.testing.assert_array_equal(back, np.asarray(arr))
+
+
+def test_put_payload_not_copied_on_send():
+    """The put path must stream the array's own memory: dump_array_parts
+    returns a view over the (C-contiguous) input, not a copy."""
+    import numpy as np
+    from kubeshare_tpu.isolation import protocol
+
+    arr = np.arange(1024, dtype=np.float32)
+    parts = protocol.dump_array_parts(arr)
+    data = parts[1]
+    assert isinstance(data, memoryview)
+    assert data.obj is arr  # same backing memory — zero-copy
