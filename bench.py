@@ -44,6 +44,15 @@ import time
 import numpy as np
 
 
+def _mark(msg: str) -> None:
+    """Phase marker on stderr: when the tunnel wedges mid-run, the last
+    marker in the captured stderr says exactly which phase hung —
+    otherwise a 700 s watchdog kill is unattributable (round-5 bench
+    attempt died with an empty stderr)."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
 def _probe_backend(timeout_s: float, attempts: int = 3) -> str | None:
     """Initialize the JAX backend in a THROWAWAY subprocess first.
 
@@ -223,9 +232,12 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
     # without hours of wall clock; quota/min keep their parity values.
     if window_ms is None:
         window_ms = WINDOW_MS
+    _mark("initializing backend")
     platform = jax.devices()[0].platform
+    _mark(f"backend up: {platform}; exclusive plain phase")
 
     exclusive_plain = _exclusive_steps_per_sec(exclusive_s)
+    _mark(f"exclusive plain: {exclusive_plain:.2f} steps/s")
     # The fused baseline costs an extra XLA compile (tens of seconds on
     # the CPU test backend) — auto-skipped only for toy-duration runs;
     # any run whose ratio is REPORTED must pay it, or the co-located
@@ -235,6 +247,7 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
     exclusive_fused_sps = (_exclusive_steps_per_sec(exclusive_s,
                                                     fused_chunk=chunk)
                            if exclusive_fused else 0.0)
+    _mark(f"exclusive fused: {exclusive_fused_sps:.2f} steps/s")
     exclusive_sps = max(exclusive_plain, exclusive_fused_sps)
     if settle_s is None:
         # Skip the startup transient, but never settle longer than we
@@ -244,6 +257,7 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
     proxy = ChipProxy(scheduler=TokenScheduler(window_ms, BASE_QUOTA_MS,
                                                MIN_QUOTA_MS))
     proxy.serve()
+    _mark(f"proxy serving on {proxy.port}; starting co-located clients")
     try:
         barrier = threading.Barrier(2)
         results: dict = {}
@@ -259,6 +273,7 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
             t.start()
         for t in threads:
             t.join()
+        _mark("co-located clients joined")
     finally:
         proxy.close()
 
@@ -335,9 +350,14 @@ def main(argv=None) -> int:
             elif not a.startswith("--watchdog="):
                 child_args.append(a)
         try:
+            # stderr is INHERITED, not captured: the child's _mark phase
+            # markers must reach the operator's stderr live — buffering
+            # them in the parent loses every marker when the parent
+            # itself is killed externally (onchip_window.sh's timeout),
+            # and the TimeoutExpired path would drop them too.
             proc = subprocess.run(
                 [sys.executable, __file__, *child_args, "--watchdog", "0"],
-                timeout=budget, capture_output=True, text=True)
+                timeout=budget, stdout=subprocess.PIPE, text=True)
         except subprocess.TimeoutExpired:
             print(json.dumps({"metric": "colocated_2x0.5_aggregate_ratio",
                               "value": 0.0, "unit": "fraction",
@@ -346,10 +366,11 @@ def main(argv=None) -> int:
                                        "(tunnel wedged mid-run?)"}))
             return 1
         sys.stdout.write(proc.stdout)
-        sys.stderr.write(proc.stderr)
         return proc.returncode
 
+    _mark("probing backend in a subprocess")
     err = _probe_backend(args.probe_timeout)
+    _mark(f"probe result: {err or 'healthy'}")
     if err is not None:
         # The chip is unreachable (the axon tunnel wedges for hours at a
         # time) — fall back to the CPU backend: the isolation runtime is

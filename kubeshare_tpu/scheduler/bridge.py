@@ -325,7 +325,8 @@ class PodEventBridge:
                 last_err = RuntimeError(f"/state returned {code}")
             except Exception as e:
                 last_err = e
-            time.sleep(0.5 * (attempt + 1))
+            if attempt < 2:          # no pointless sleep after the last try
+                time.sleep(0.5 * (attempt + 1))
         if engine_pods is None:
             # Defer the whole relist rather than degrade: proceeding with
             # an empty engine set would skip the deletion reconcile, and

@@ -53,7 +53,7 @@ def _env_entry(name: str, field_path: str) -> dict:
             "valueFrom": {"fieldRef": {"fieldPath": field_path}}}
 
 
-def injected_env(pr) -> list[dict]:
+def injected_env(pr, labels: dict) -> list[dict]:
     """The downward-API env block for a parsed :class:`PodRequest`.
 
     Every ``fieldRef`` must resolve when the kubelet starts the container
@@ -61,7 +61,10 @@ def injected_env(pr) -> list[dict]:
     emitted only when the engine is guaranteed to have written that
     annotation before bind (``engine.Binding.annotations``):
     ``tpu_chip_id``/``tpu_mem`` always; ``tpu_manager_port`` only for
-    fractional (token-scheduled) pods; ``group_rank`` only for full gangs.
+    fractional (token-scheduled) pods; ``group_rank`` only for full
+    gangs. Label refs only for labels the pod actually carries —
+    ``tpu_request`` is optional (burst-only share defaults to 0), so an
+    absent label gets a literal "0" instead of a dangling fieldRef.
     """
     env = [
         _env_entry(C.ENV_POD_NAME, "metadata.name"),
@@ -72,14 +75,17 @@ def injected_env(pr) -> list[dict]:
     ]
     if 0.0 < pr.limit <= 1.0:
         # fractional share → pod manager + token runtime in the path
-        env += [
-            _env_entry(C.ENV_POD_MANAGER_PORT,
-                       f"metadata.annotations['{C.POD_MANAGER_PORT}']"),
-            _env_entry(C.ENV_TPU_REQUEST,
-                       f"metadata.labels['{C.POD_TPU_REQUEST}']"),
-            _env_entry(C.ENV_TPU_LIMIT,
-                       f"metadata.labels['{C.POD_TPU_LIMIT}']"),
-        ]
+        env.append(_env_entry(
+            C.ENV_POD_MANAGER_PORT,
+            f"metadata.annotations['{C.POD_MANAGER_PORT}']"))
+        if C.POD_TPU_REQUEST in labels:
+            env.append(_env_entry(
+                C.ENV_TPU_REQUEST,
+                f"metadata.labels['{C.POD_TPU_REQUEST}']"))
+        else:
+            env.append({"name": C.ENV_TPU_REQUEST, "value": "0"})
+        env.append(_env_entry(
+            C.ENV_TPU_LIMIT, f"metadata.labels['{C.POD_TPU_LIMIT}']"))
     if pr.group_name:
         env.append(_env_entry(C.ENV_GROUP_NAME,
                               f"metadata.labels['{C.POD_GROUP_NAME}']"))
@@ -124,7 +130,7 @@ def mutate_pod(pod: dict, scheduler_name: str = C.SCHEDULER_NAME,
     if not pr.needs_tpu:
         return patch  # group/priority labels only: no env/volume needed
 
-    env_block = injected_env(pr)
+    env_block = injected_env(pr, labels)
     for i, ctr in enumerate(spec.get("containers") or []):
         have = {e.get("name") for e in (ctr.get("env") or [])}
         missing = [e for e in env_block if e["name"] not in have]
@@ -256,9 +262,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if not self.path.startswith("/mutate"):
             self._reply(404, {"error": "not found"})
             return
+        # Recover the request uid BEFORE the fallible work: an error
+        # reply whose uid does not echo the request's is itself rejected
+        # by the apiserver as a webhook failure — which would turn this
+        # intended denial into whatever failurePolicy says.
+        uid = ""
         try:
             n = int(self.headers.get("Content-Length", "0"))
             review = json.loads(self.rfile.read(n))
+            uid = str((review.get("request") or {}).get("uid", ""))
             self._reply(200, admission_response(
                 review, scheduler_name=self.server.scheduler_name))
         except Exception as e:  # malformed review: deny, never crash
@@ -266,7 +278,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._reply(200, {
                 "apiVersion": "admission.k8s.io/v1",
                 "kind": "AdmissionReview",
-                "response": {"uid": "", "allowed": False,
+                "response": {"uid": uid, "allowed": False,
                              "status": {"code": 400, "message": str(e)}}})
 
 

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Round-5 tunnel sentry: probe on a cadence, exploit the first healthy
+# window (VERDICT r4 next-1: "probe first, every session").
+#
+# Every PERIOD seconds: subprocess-probe jax.devices() with a 45 s cap,
+# appending one line to doc/probe-r05.log. On a healthy probe, run
+# scripts/onchip_window.sh (which commits each artifact as it lands).
+# Stop once BENCH_ONCHIP.json holds a real measurement (no "error" key);
+# keep sentry-ing after failed exploits — the tunnel flaps.
+set -u
+cd "$(dirname "$0")/.."
+PERIOD=${PERIOD:-600}
+LOG=doc/probe-r05.log
+
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+while true; do
+  if python - <<'EOF' >/dev/null 2>&1
+import json, subprocess, sys
+proc = subprocess.run(
+    [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+    capture_output=True, text=True, timeout=45)
+sys.exit(0 if proc.returncode == 0 and "tpu" in proc.stdout else 1)
+EOF
+  then
+    echo "[$(stamp)] probe HEALTHY" >> "$LOG"
+    echo "[$(stamp)] exploiting window" >> "$LOG"
+    SKIP_PROBE=1 bash scripts/onchip_window.sh >> "$LOG" 2>&1
+    # Done only on a REAL on-chip measurement: no "error", and platform
+    # is the tpu itself — a cpu-fallback result (tunnel flapped between
+    # probe and bench) has no "error" key and must NOT end the watch.
+    if [ -s BENCH_ONCHIP.json ] && ! grep -q '"error"' BENCH_ONCHIP.json \
+        && grep -q '"platform": "tpu' BENCH_ONCHIP.json; then
+      echo "[$(stamp)] north-star landed — sentry done" >> "$LOG"
+      git add "$LOG" && git commit -qm "Probe log: on-chip window captured" \
+        --no-verify || true
+      exit 0
+    fi
+    echo "[$(stamp)] exploit did not land a clean bench; resuming" >> "$LOG"
+  else
+    echo "[$(stamp)] probe wedged (rc!=0 or timeout)" >> "$LOG"
+  fi
+  sleep "$PERIOD"
+done

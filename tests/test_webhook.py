@@ -241,3 +241,47 @@ class TestExamplesStayMinimal:
         for name in (C.ENV_POD_NAME, C.ENV_POD_MANAGER_PORT,
                      C.ENV_TPU_REQUEST, C.ENV_TPU_LIMIT, C.ENV_TPU_MEMORY):
             assert name in env_names(ctr)
+
+
+class TestReviewFixes:
+    def test_limit_only_pod_gets_literal_request_default(self):
+        # tpu_request is optional; a fieldRef to the absent label would
+        # CreateContainerConfigError the container (review r5 finding)
+        out = mutated(labels_only_pod({C.POD_TPU_LIMIT: "0.5"}))
+        ctr = out["spec"]["containers"][0]
+        for e in ctr["env"]:
+            if e["name"] == C.ENV_TPU_REQUEST:
+                assert e == {"name": C.ENV_TPU_REQUEST, "value": "0"}
+                break
+        else:
+            raise AssertionError("request env missing")
+        assert C.ENV_POD_MANAGER_PORT in env_names(ctr)
+
+    def test_malformed_review_denial_echoes_uid(self):
+        # a denial whose uid does not echo the request's is itself
+        # treated as a webhook failure by the apiserver
+        server = WebhookServer(host="127.0.0.1").start()
+        try:
+            out = TestServer().post(
+                f"http://127.0.0.1:{server.port}/mutate",
+                {"request": {"uid": "u-echo", "kind": {"kind": "Pod"},
+                             "object": "not-a-pod-object"}})
+            resp = out["response"]
+            assert resp["uid"] == "u-echo"
+            assert not resp["allowed"]
+        finally:
+            server.stop()
+
+    def test_webhook_manifest_covers_all_optin_keys(self):
+        docs = list(yaml.safe_load_all(
+            (EXAMPLES.parent / "deploy" / "webhook.yaml").read_text()))
+        cfg = [d for d in docs if d and
+               d.get("kind") == "MutatingWebhookConfiguration"][0]
+        keys = set()
+        for wh in cfg["webhooks"]:
+            assert wh["failurePolicy"] == "Fail"  # no isolation bypass
+            for expr in wh["objectSelector"]["matchExpressions"]:
+                assert expr["operator"] == "Exists"
+                keys.add(expr["key"])
+        assert keys == {C.POD_TPU_LIMIT, C.POD_TPU_REQUEST,
+                        C.POD_GROUP_NAME}
