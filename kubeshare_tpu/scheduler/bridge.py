@@ -316,7 +316,8 @@ class PodEventBridge:
         """
         engine_pods: set[str] | None = None
         last_err: Exception | None = None
-        for attempt in range(3):
+        attempts = 3
+        for attempt in range(attempts):
             try:
                 code, st = self.service.state()
                 if code == 200:
@@ -325,7 +326,7 @@ class PodEventBridge:
                 last_err = RuntimeError(f"/state returned {code}")
             except Exception as e:
                 last_err = e
-            if attempt < 2:          # no pointless sleep after the last try
+            if attempt < attempts - 1:  # no pointless sleep after last try
                 time.sleep(0.5 * (attempt + 1))
         if engine_pods is None:
             # Defer the whole relist rather than degrade: proceeding with
