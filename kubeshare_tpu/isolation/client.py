@@ -406,11 +406,30 @@ class HbmCap:
                 # The backend HAS stats; this one poll failed (e.g. a
                 # transport hiccup on a tunnelled runtime). Killing an
                 # hours-old healthy pod over one failed poll would be
-                # fail-closed in the wrong place — skip this poll.
+                # fail-closed in the wrong place — skip this poll. Stamp
+                # the throttle so a stats outage degrades to one poll
+                # per interval, not one per eager op.
+                self._last_poll = time.monotonic()
                 log.warning("memory_stats() poll failed transiently "
                             "(%s); skipping this check", exc)
                 return
-            stats = None
+            # First-ever poll: a transient transport error is NOT
+            # "backend has no stats" — retry briefly before deciding,
+            # and when it still fails, say what actually happened.
+            for _ in range(3):
+                time.sleep(0.1)
+                try:
+                    stats = self._stats()
+                    break
+                except Exception as retry_exc:
+                    exc = retry_exc
+            else:
+                raise SystemExit(
+                    f"kubeshare-tpu: tpu_mem={self.cap_bytes} is granted "
+                    f"but the allocator stats query keeps failing "
+                    f"({exc}) — the HBM cap cannot be enforced in gate "
+                    f"mode. Refusing to run unenforced; fix the device "
+                    f"runtime or drop sharedtpu/tpu_mem.")
         if stats is None:
             # Fail CLOSED (VERDICT r4 weak-2): a backend with no
             # allocator stats cannot enforce tpu_mem — running anyway

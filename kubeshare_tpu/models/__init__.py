@@ -11,7 +11,8 @@ CLI; ``common.run_training`` provides the timed loop with the isolation
 gate hook.
 """
 
-MODEL_NAMES = ("mnist", "cifar10", "lstm", "resnet", "vgg", "transformer")
+MODEL_NAMES = ("mnist", "cifar10", "lstm", "resnet", "vgg", "transformer",
+               "tinymlp")
 
 
 def get_model(name: str):

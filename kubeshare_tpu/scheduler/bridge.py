@@ -210,6 +210,10 @@ def pod_fields(pod: dict) -> dict:
     }
 
 
+class WatchExpired(RuntimeError):
+    """The watch's resourceVersion aged out (410 Gone) — relist now."""
+
+
 class PodEventBridge:
     """Convert pod events into scheduler-service calls and write back."""
 
@@ -235,6 +239,16 @@ class PodEventBridge:
     # -- event handling ------------------------------------------------------
 
     def handle(self, etype: str, pod: dict) -> None:
+        if etype == "ERROR":
+            # The apiserver reports watch errors in-band as Status
+            # objects; 410 Gone means our resourceVersion aged out of
+            # etcd's window — the remaining stream is useless and only a
+            # fresh LIST re-establishes a valid bookmark. Raise so run()
+            # drops the stream and re-enters sync_once immediately
+            # (client-go's reflector does the same relist).
+            code = int(pod.get("code", 0) or 0)
+            raise WatchExpired(f"watch ERROR event (code {code}): "
+                               f"{pod.get('message', '')}")
         f = pod_fields(pod)
         if f["scheduler"] != self.scheduler_name or not f["name"]:
             return
@@ -365,6 +379,7 @@ class PodEventBridge:
         """List+watch until :meth:`stop`; reconnects with a fixed backoff
         (a dropped watch is routine — the API server times streams out)."""
         while not self._stop.is_set():
+            relist_now = False
             try:
                 version = self.sync_once()
                 for etype, obj in self.kube.watch_pods(
@@ -373,11 +388,20 @@ class PodEventBridge:
                         return
                     try:
                         self.handle(etype, obj)
+                    except WatchExpired as e:
+                        # 410 Gone: the stream is dead — relist NOW for
+                        # a fresh bookmark (no reconnect backoff: the
+                        # server is healthy, only our version aged out —
+                        # client-go's reflector relists immediately too)
+                        log.info("watch expired: %s — relisting", e)
+                        relist_now = True
+                        break
                     except Exception as e:
                         log.warning("event %s failed: %s", etype, e)
             except Exception as e:
                 log.warning("watch dropped: %s", e)
-            self._stop.wait(self.reconnect_s)
+            if not relist_now:
+                self._stop.wait(self.reconnect_s)
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_s):

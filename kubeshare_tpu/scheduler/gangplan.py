@@ -81,51 +81,145 @@ def _tile_host(coords: set[tuple[int, ...]], k: int,
     return None
 
 
+def _root_free(root_leaves: list[Cell]):
+    """→ ``(free, mesh)``: whole-free healthy leaves keyed by
+    origin-normalized coords, plus the root's derived mesh shape; None
+    when the root's leaves carry no usable coordinates."""
+    derived = node_mesh_shape(root_leaves)
+    if derived is None:
+        return None
+    origin, mesh = derived
+    free = {tuple(x - o for x, o in zip(leaf.coords, origin)): leaf
+            for leaf in root_leaves
+            if leaf.available == leaf.leaf_cell_number and leaf.healthy}
+    return free, mesh
+
+
+def _block_in_root(free: dict, mesh: tuple[int, ...], total: int,
+                   per_member: int,
+                   shapes: list[tuple[int, ...]] | None = None
+                   ) -> tuple[list[Slot], tuple[int, ...], tuple] | None:
+    """One contiguous ``total``-chip block inside one root, carved into
+    ``per_member`` host-local sub-blocks → ``(slots, block_shape,
+    tiling_signature)``. ``shapes`` restricts the candidate block shapes;
+    the signature is the sorted tuple of member-tile anchors RELATIVE to
+    the block anchor — the cross-slice planner demands identical
+    signatures so rank r occupies the same relative position in every
+    slice (same shape alone is not enough: host boundaries can tile the
+    same shape into different sub-block geometries)."""
+    if len(free) < total:
+        return None
+    for shape in (shapes if shapes is not None
+                  else block_shapes(total, mesh)):
+        if any(s > m for s, m in zip(shape, mesh)):
+            continue
+        # Non-wrapping anchors only (ADVICE r4): the derived
+        # bounding-box mesh has no physical wrap links unless the
+        # block spans the axis's full extent — and a full-extent
+        # block is exactly the anchor-0 non-wrapping placement.
+        for anchor in itertools.product(
+                *[range(m - s + 1) for m, s in zip(mesh, shape)]):
+            coords = _block_coords(anchor, shape, mesh)
+            if any(c not in free for c in coords):
+                continue
+            by_host: dict[str, set[tuple[int, ...]]] = {}
+            for c in coords:
+                by_host.setdefault(free[c].node, set()).add(c)
+            if any(len(cs) % per_member for cs in by_host.values()):
+                continue
+            slots: list[tuple[tuple[int, ...], Slot]] = []
+            ok = True
+            for node in sorted(by_host):
+                tiles = _tile_host(by_host[node], per_member, mesh)
+                if tiles is None:
+                    ok = False
+                    break
+                for tile in tiles:
+                    # order key is the tile anchor RELATIVE to the block
+                    # anchor: two same-shape blocks in different slices
+                    # then order their member ranks identically, which
+                    # is what aligns dp-ranks across the DCN axis
+                    rel = tuple(t - a for t, a in zip(tile[0], anchor))
+                    slots.append((rel, (node, tuple(
+                        free[c].chip_id for c in tile))))
+            if ok:
+                # order along the block: consecutive ranks on
+                # neighbouring sub-blocks
+                ordered = sorted(slots)
+                return ([slot for _, slot in ordered], shape,
+                        tuple(rel for rel, _ in ordered))
+    return None
+
+
 def plan_gang(leaves: list[Cell], members: int,
               per_member: int) -> list[Slot] | None:
     """A slot per gang member — ``(node, chip_ids)`` with ``per_member``
-    contiguous whole-free chips on one host, the union a contiguous
-    torus block — or None when no such placement exists right now."""
+    contiguous whole-free chips on one host — or None when no such
+    placement exists right now.
+
+    Two levels (SURVEY §5's ICI/DCN tiers; VERDICT r4 missing-4):
+
+    1. **single slice**: the whole gang as one contiguous torus block in
+       one tree root (ICI only — always preferred);
+    2. **cross-slice (DCN tier)**: when no root fits the gang, split it
+       over the FEWEST slices S (S divides the member count) with one
+       contiguous block per slice, all blocks the SAME shape and member
+       ranks ordered identically inside each block. Slots are emitted
+       slice-major, so rank r lands in slice ``r // (members/S)`` —
+       exactly the ``(dcn, dp, tp)`` layout ``parallel.mesh
+       .make_hybrid_mesh`` builds: the DCN axis crosses slices, dp/tp
+       stay inside ICI. Reference analogue: multi-node cells
+       (``deploy/config/kubeshare-config-final.yaml`` ``2-V100-NODE``).
+    """
     total = members * per_member
+    roots = []
     for root_leaves in _roots(leaves).values():
-        derived = node_mesh_shape(root_leaves)
-        if derived is None:
+        rf = _root_free(root_leaves)
+        if rf is not None and rf[0]:
+            roots.append(rf)
+    # deterministic slice order (the _roots dict is keyed by object id):
+    # smallest chip id in the root — stable across planner invocations
+    roots.sort(key=lambda rf: min(c.chip_id for c in rf[0].values()))
+
+    # level 1: the whole gang inside one slice (no DCN in the gang mesh)
+    for free, mesh in roots:
+        found = _block_in_root(free, mesh, total, per_member)
+        if found is not None:
+            return found[0]
+
+    # level 2: S equal slices, one same-shape block each, slice-major
+    for S in range(2, len(roots) + 1):
+        if members % S:
             continue
-        origin, mesh = derived
-        free = {tuple(x - o for x, o in zip(leaf.coords, origin)): leaf
-                for leaf in root_leaves
-                if leaf.available == leaf.leaf_cell_number and leaf.healthy}
-        if len(free) < total:
-            continue
-        for shape in block_shapes(total, mesh):
-            # Non-wrapping anchors only (ADVICE r4): the derived
-            # bounding-box mesh has no physical wrap links unless the
-            # block spans the axis's full extent — and a full-extent
-            # block is exactly the anchor-0 non-wrapping placement.
-            for anchor in itertools.product(
-                    *[range(m - s + 1) for m, s in zip(mesh, shape)]):
-                coords = _block_coords(anchor, shape, mesh)
-                if any(c not in free for c in coords):
+        sub_members = members // S
+        sub_total = sub_members * per_member
+        # candidate shapes must fit SOME root; iterate most-compact first
+        # over the union of each root's shape menu
+        shape_menu: list[tuple[int, ...]] = []
+        for _, mesh in roots:
+            for shape in block_shapes(sub_total, mesh):
+                if shape not in shape_menu:
+                    shape_menu.append(shape)
+        for shape in shape_menu:
+            picked: list[list[Slot]] = []
+            signature = None
+            for free, mesh in roots:
+                found = _block_in_root(free, mesh, sub_total, per_member,
+                                       shapes=[shape])
+                if found is None:
                     continue
-                by_host: dict[str, set[tuple[int, ...]]] = {}
-                for c in coords:
-                    by_host.setdefault(free[c].node, set()).add(c)
-                if any(len(cs) % per_member for cs in by_host.values()):
+                if signature is None:
+                    signature = found[2]
+                elif found[2] != signature:
+                    # same shape but a DIFFERENT tiling geometry (host
+                    # boundaries cut the block differently): ranks would
+                    # not align across the DCN axis — skip this slice
                     continue
-                slots: list[tuple[tuple[int, ...], Slot]] = []
-                ok = True
-                for node in sorted(by_host):
-                    tiles = _tile_host(by_host[node], per_member, mesh)
-                    if tiles is None:
-                        ok = False
-                        break
-                    for tile in tiles:
-                        slots.append((tile[0], (node, tuple(
-                            free[c].chip_id for c in tile))))
-                if ok:
-                    # order along the block: consecutive ranks on
-                    # neighbouring sub-blocks
-                    return [slot for _, slot in sorted(slots)]
+                picked.append(found[0])
+                if len(picked) == S:
+                    break
+            if len(picked) == S:
+                return [slot for block in picked for slot in block]
     return None
 
 

