@@ -1,18 +1,20 @@
 #!/usr/bin/env bash
 # One-shot exploitation of a healthy axon-tunnel window.
 #
-# Healthy windows are SHORT (round-3/4 observation: the tunnel flaps and
-# wedges for hours); when a probe succeeds there is no time to decide
-# what to run — this script runs everything in north-star-first order
-# and commits after EACH artifact, so a mid-window wedge still keeps
-# whatever landed.
+# Healthy windows are SHORT (rounds 3-5 observation: the tunnel flaps —
+# the round-5 00:59 UTC window wedged again in under a minute); when a
+# probe succeeds there is no time to decide what to run. This script
+# runs artifacts in INCREASING-COST order and commits after EACH, so
+# even a seconds-long window keeps something:
 #
-#   1. probe (45 s cap) — abort cleanly if the tunnel is still wedged
-#   2. bench.py, full knobs (>=3 Gemini-parity 10 s windows co-located)
-#      -> BENCH_ONCHIP.json, committed immediately
-#   3. scripts/e2e_onchip.py --steps 300 (two zero-touch mnist pods at
+#   1. probe (45 s cap, skippable via SKIP_PROBE=1 from probe_loop.sh)
+#   2. discovery snapshot (~20 s) -> doc/e2e-onchip.log, committed
+#   3. micro ratio probe (~90 s: exclusive 3 s + co-located 12 s at the
+#      parity window — 1 window, labeled exploratory) -> doc/, committed
+#   4. bench.py, FULL knobs (>=3 Gemini-parity 10 s windows co-located)
+#      -> BENCH_ONCHIP.json, committed — the round's north star
+#   5. scripts/e2e_onchip.py --steps 300 (two zero-touch mnist pods at
 #      0.5 + 0.5 on the real chip) -> doc/e2e-onchip.log, committed
-#   4. discovery snapshot (chip model/HBM/coords) appended to the log
 #
 # Run from the repo root:  bash scripts/onchip_window.sh
 set -u
@@ -32,34 +34,50 @@ else
     exit 1
   fi
 fi
-echo "[$(stamp)] HEALTHY — running the north-star bench (full knobs)"
+echo "[$(stamp)] HEALTHY — artifacts in increasing-cost order"
 
-if timeout 900 python bench.py --exclusive-seconds 5 --colocated-seconds 35 \
-    > BENCH_ONCHIP.json 2> doc/bench-onchip.err; then
-  cat BENCH_ONCHIP.json
-  git add BENCH_ONCHIP.json doc/bench-onchip.err
-  git commit -m "On-chip north-star bench from a healthy tunnel window" \
-    --no-verify -q || true
-else
-  echo "[$(stamp)] bench failed mid-window:"; tail -5 doc/bench-onchip.err
-fi
-
-echo "[$(stamp)] e2e: two zero-touch pods on the real chip"
-if timeout 700 python scripts/e2e_onchip.py --steps 300 \
-    > doc/e2e-onchip.log 2>&1; then
-  tail -12 doc/e2e-onchip.log
-  git add doc/e2e-onchip.log
-  git commit -m "On-chip e2e: two zero-touch pods share the chip" \
-    --no-verify -q || true
-else
-  echo "[$(stamp)] e2e failed mid-window:"; tail -8 doc/e2e-onchip.log
-fi
-
-echo "[$(stamp)] discovery snapshot"
-timeout 120 python - <<'EOF' >> doc/e2e-onchip.log 2>&1 || true
+echo "[$(stamp)] 1/4 discovery snapshot (~20 s)"
+timeout 120 python - >> doc/e2e-onchip.log 2>&1 <<'EOF' || true
 from kubeshare_tpu.topology.discovery import discover_chips
 for c in discover_chips("jax"):
     print(c.chip_id, c.model, c.memory >> 30, "GiB", c.coords, c.slice_id)
 EOF
-git add -A && git commit -m "On-chip discovery snapshot" --no-verify -q || true
+tail -3 doc/e2e-onchip.log
+git add doc/e2e-onchip.log
+git commit -qm "On-chip discovery snapshot" --no-verify || true
+
+echo "[$(stamp)] 2/4 micro ratio probe (~90 s, exploratory: 1 window)"
+if timeout 300 python bench.py --exclusive-seconds 3 --colocated-seconds 12 \
+    --probe-timeout 45 > doc/bench-onchip-micro.json 2>> doc/bench-onchip.err
+then
+  cat doc/bench-onchip-micro.json
+  git add doc/bench-onchip-micro.json doc/bench-onchip.err
+  git commit -qm "On-chip micro ratio probe (exploratory single window)" \
+    --no-verify || true
+else
+  echo "[$(stamp)] micro bench failed:"; tail -3 doc/bench-onchip.err
+fi
+
+echo "[$(stamp)] 3/4 north-star bench (full knobs, ~3-10 min)"
+if timeout 900 python bench.py --exclusive-seconds 5 --colocated-seconds 35 \
+    --probe-timeout 45 > BENCH_ONCHIP.json 2>> doc/bench-onchip.err; then
+  cat BENCH_ONCHIP.json
+  git add BENCH_ONCHIP.json doc/bench-onchip.err
+  git commit -qm "On-chip north-star bench from a healthy tunnel window" \
+    --no-verify || true
+else
+  echo "[$(stamp)] bench failed mid-window:"; tail -5 doc/bench-onchip.err
+fi
+
+echo "[$(stamp)] 4/4 e2e: two zero-touch pods on the real chip"
+if timeout 700 python scripts/e2e_onchip.py --steps 300 \
+    >> doc/e2e-onchip.log 2>&1; then
+  tail -12 doc/e2e-onchip.log
+  git add doc/e2e-onchip.log
+  git commit -qm "On-chip e2e: two zero-touch pods share the chip" \
+    --no-verify || true
+else
+  echo "[$(stamp)] e2e failed mid-window:"; tail -8 doc/e2e-onchip.log
+fi
+git add -A doc/ 2>/dev/null; git commit -qm "On-chip window logs" --no-verify || true
 echo "[$(stamp)] window exploited — artifacts committed"
