@@ -113,10 +113,13 @@ class FrameTooLarge(ValueError):
     would otherwise destroy the whole session's device state)."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     # Preallocate + recv_into: the naive recv/extend loop tops out well
     # under 0.5 GB/s on loopback (per-chunk temporaries); this path does
-    # multi-GB/s and checkpoint-sized buffers ride it.
+    # multi-GB/s and checkpoint-sized buffers ride it. Returns the
+    # bytearray ITSELF — a bytes(buf) conversion would memcpy the whole
+    # frame a second time (load_array views bytearrays zero-copy, and
+    # a mutable receive buffer is what its writable=True path wants).
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -126,7 +129,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ProtocolError("peer closed mid-frame" if got
                                 else "peer closed")
         got += r
-    return bytes(buf)
+    return buf
 
 
 def send_msg(sock: socket.socket, msg: dict, blob=None) -> None:
@@ -152,7 +155,7 @@ def send_msg(sock: socket.socket, msg: dict, blob=None) -> None:
             sock.sendall(p)
 
 
-def recv_msg(sock: socket.socket) -> tuple[dict, bytes | None]:
+def recv_msg(sock: socket.socket) -> tuple[dict, bytearray | None]:
     (size,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if size > MAX_FRAME:
         raise ProtocolError(f"frame too large: {size}")
@@ -174,7 +177,7 @@ class Connection:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
 
-    def call(self, msg: dict, blob: bytes | None = None) -> tuple[dict, bytes | None]:
+    def call(self, msg: dict, blob=None) -> tuple[dict, bytearray | None]:
         with self._lock:
             try:
                 send_msg(self.sock, msg, blob)

@@ -56,6 +56,8 @@ then
     --no-verify || true
 else
   echo "[$(stamp)] micro bench failed:"; tail -3 doc/bench-onchip.err
+  # never commit a truncated artifact as if it were a measurement
+  rm -f doc/bench-onchip-micro.json
 fi
 
 echo "[$(stamp)] 3/4 north-star bench (full knobs, ~3-10 min)"

@@ -48,10 +48,10 @@ def test_bridge_schedules_through_real_apiserver():
     registry = TelemetryRegistry()
     node_name = os.environ.get("KUBESHARE_TPU_TEST_NODE", "")
     assert node_name, "set KUBESHARE_TPU_TEST_NODE to a schedulable node"
-    chips = FakeTopology(hosts=1, mesh=(2,), host_prefix=node_name).chips()
-    # FakeTopology appends "-0"; rename to the real node
-    for c in chips:
-        c.host = node_name
+    import dataclasses
+    chips = [dataclasses.replace(c, host=node_name)  # ChipInfo is frozen;
+             for c in FakeTopology(                  # drop the fake "-0"
+                 hosts=1, mesh=(2,), host_prefix=node_name).chips()]
     registry.put_capacity(node_name, [c.to_labels() for c in chips])
     eng = SchedulerEngine()
     svc = SchedulerService(eng, registry)
