@@ -118,13 +118,21 @@ batch_fn = partial(synthetic_token_batch, batch_size=BATCH_SIZE,
 
 def _loss_for_mesh(mesh):
     """Sequence-parallel loss when the gang's mesh carries an ``sp``
-    axis (e.g. ``KUBESHARE_TPU_MESH="dp=2,sp=2,tp=2"``): ring attention
-    over the sequence ring, dense otherwise (None = keep the default)."""
+    axis (e.g. ``KUBESHARE_TPU_MESH="dp=2,sp=2,tp=2"``), dense
+    otherwise (None = keep the default). Strategy is selectable via
+    ``KUBESHARE_TPU_SP_ATTN``: ``ring`` (default — any head count,
+    O((seq/sp)²) score memory) or ``ulysses`` (all-to-all head/sequence
+    exchange — two collectives total, needs heads divisible by sp; see
+    ``parallel/ulysses.py``)."""
     if "sp" not in mesh.axis_names:
         return None
-    from ..parallel.ringattention import make_ring_attention
-    ring = make_ring_attention(mesh)
-    return partial(loss_fn, attn_fn=ring)
+    if os.environ.get("KUBESHARE_TPU_SP_ATTN", "ring").lower() == "ulysses":
+        from ..parallel.ulysses import make_ulysses_attention
+        attn = make_ulysses_attention(mesh)
+    else:
+        from ..parallel.ringattention import make_ring_attention
+        attn = make_ring_attention(mesh)
+    return partial(loss_fn, attn_fn=attn)
 
 
 def _token_sharding_hook(mesh):

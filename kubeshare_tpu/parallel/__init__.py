@@ -13,6 +13,7 @@ from .mesh import (
 from .pipeline import (make_pipeline, microbatch, pipeline_shard,
                        stage_sharding)
 from .ringattention import make_ring_attention, ring_attention_shard
+from .ulysses import make_ulysses_attention, ulysses_attention_shard
 
 __all__ = [
     "data_sharding",
@@ -20,12 +21,14 @@ __all__ = [
     "make_mesh",
     "make_pipeline",
     "make_ring_attention",
+    "make_ulysses_attention",
     "make_sharded_train_step",
     "microbatch",
     "param_sharding",
     "pipeline_shard",
     "replicated",
     "ring_attention_shard",
+    "ulysses_attention_shard",
     "shard_init",
     "stage_sharding",
     "token_sharding",

@@ -1,0 +1,102 @@
+"""All-to-all (Ulysses-style) sequence parallelism over an ``sp`` axis.
+
+The second of the two long-context strategies SURVEY's TPU mandate names
+("ring attention or all-to-all sequence/context parallelism" — the
+reference delegates all model math to its workload images,
+``test/distribute/**``). Complementary to :mod:`.ringattention`:
+
+- **ring** keeps sequence sharded THROUGH attention and rotates k/v one
+  ICI hop per step: per-device score memory O((seq/sp)²·heads), sp
+  permute steps on the critical path. Scales to any head count.
+- **ulysses** re-shards with two ``all_to_all`` collectives: heads are
+  exchanged for sequence, so each device computes attention over the
+  FULL sequence for ``heads/sp`` of the heads, entirely locally, then
+  the output is exchanged back. One collective before + one after
+  (each moving the activation tensor once over ICI), no per-step
+  latency chain — usually the better fit when ``heads % sp == 0`` and
+  the local attention is flash/blockwise (which keeps the O(seq²)
+  score tile out of HBM). Requires ``heads`` divisible by ``sp``.
+
+Both produce EXACT attention; pick per model shape. The local attention
+body is pluggable (defaults to the dense reference; pass the Pallas
+flash kernel for long sequences on the chip).
+
+Layout convention matches :mod:`.ringattention`: global arrays are
+(batch, seq, heads, head_dim), sharded ``P(dp, sp, tp, None)``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+
+
+def ulysses_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
+                            axis_name: str, causal: bool = True,
+                            attn_fn=None) -> jax.Array:
+    """Per-shard all-to-all attention body. MUST run inside ``shard_map``
+    where ``axis_name`` maps the sequence axis.
+
+    ``q``/``k``/``v``: (batch, block, heads, head_dim) — this device's
+    sequence block with ALL (mesh-local) heads. Returns the local
+    queries' attention output, same shape, fp32.
+    """
+    sp = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % sp:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by sp ({sp}); "
+            f"use ring attention for this shape")
+    if attn_fn is not None and causal:
+        # a custom body owns ALL the attention math, masking included —
+        # silently un-masking a "causal=True" caller would be a footgun
+        raise ValueError(
+            "attn_fn supplied: causal masking is the attn_fn's job — "
+            "pass causal=False and bake the mask into attn_fn (e.g. "
+            "partial(flash_attention, causal=True))")
+    attn = attn_fn or partial(dot_product_attention, causal=causal)
+
+    def seq_to_heads(x):
+        # (b, seq/sp, h, d) -> (b, seq, h/sp, d): split the head axis
+        # across the group, concatenate the sequence axis back together
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    o = attn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v))
+    return heads_to_seq(o)
+
+
+def make_ulysses_attention(mesh: Mesh, causal: bool = True,
+                           axis_name: str = "sp", attn_fn=None):
+    """An ``attn_fn(q, k, v)`` over GLOBAL (batch, seq, heads, head_dim)
+    arrays, sequence-sharded over ``axis_name`` via ``shard_map`` — the
+    all-to-all twin of :func:`.ringattention.make_ring_attention` (same
+    signature, drop-in interchangeable; plug into
+    :func:`kubeshare_tpu.ops.attention.mha_apply`).
+
+    Batch rides ``dp`` and heads ride ``tp`` when present; the ulysses
+    exchange then needs ``heads/tp`` divisible by the ``sp`` size.
+    """
+    names = set(mesh.axis_names)
+    if axis_name not in names:
+        raise ValueError(f"mesh {mesh.axis_names} has no {axis_name!r} axis")
+    bspec = "dp" if "dp" in names else None
+    hspec = "tp" if "tp" in names else None
+    spec = P(bspec, axis_name, hspec, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec)
+    def attn(q, k, v):
+        return ulysses_attention_shard(q, k, v, axis_name, causal=causal,
+                                       attn_fn=attn_fn)
+
+    return attn

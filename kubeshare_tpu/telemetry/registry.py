@@ -259,6 +259,8 @@ class TelemetryRegistry:
                 if path == "/metrics":
                     return self._reply(200, registry.render_metrics().encode(),
                                        "text/plain; version=0.0.4")
+                if path == "/healthz":
+                    return self._json({"ok": True})
                 self._reply(404, b"{}")
 
             def do_PUT(self):

@@ -43,6 +43,8 @@ from jax.sharding import Mesh                                 # noqa: E402
 from kubeshare_tpu.ops.attention import dot_product_attention  # noqa: E402
 from kubeshare_tpu.parallel.ringattention import (            # noqa: E402
     make_ring_attention)
+from kubeshare_tpu.parallel.ulysses import (                  # noqa: E402
+    make_ulysses_attention)
 
 B, H, D = 2, 4, 64      # batch, heads, head_dim (tiny: seq is the subject)
 SP = 4
@@ -78,6 +80,7 @@ def main() -> None:
     mesh = Mesh(devices, ("sp",))
     ring = make_ring_attention(mesh, causal=True)
     ring_j = jax.jit(ring)
+    uly_j = jax.jit(make_ulysses_attention(mesh, causal=True))
     # THE canonical dense reference the ring path is validated against
     # everywhere else (ops/attention.py; finite mask floor, fp32 scores)
     dense_j = jax.jit(dot_product_attention, static_argnames=("causal",))
@@ -94,6 +97,7 @@ def main() -> None:
         ref = dense_j(q, k, v)
         out = ring_j(q, k, v)
         err = float(jnp.max(jnp.abs(ref - out)))
+        uerr = float(jnp.max(jnp.abs(ref - uly_j(q, k, v))))
 
         rows.append({
             "seq": seq,
@@ -101,8 +105,12 @@ def main() -> None:
             "dense_steps_per_sec": round(timed_steps(dense_j, (q, k, v)), 2),
             f"ring_sp{SP}_steps_per_sec": round(
                 timed_steps(ring_j, (q, k, v)), 2),
+            "ulysses_max_abs_err_vs_dense": round(uerr, 6),
+            f"ulysses_sp{SP}_steps_per_sec": round(
+                timed_steps(uly_j, (q, k, v)), 2),
             "dense_peak_bytes": peak_bytes(dense_j, q, k, v),
             f"ring_sp{SP}_peak_bytes": peak_bytes(ring_j, q, k, v),
+            f"ulysses_sp{SP}_peak_bytes": peak_bytes(uly_j, q, k, v),
         })
         print(f"seq={seq} done", file=sys.stderr)
 

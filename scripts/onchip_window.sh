@@ -47,7 +47,11 @@ git add doc/e2e-onchip.log
 git commit -qm "On-chip discovery snapshot" --no-verify || true
 
 echo "[$(stamp)] 2/4 micro ratio probe (~90 s, exploratory: 1 window)"
-if timeout 300 python bench.py --exclusive-seconds 3 --colocated-seconds 12 \
+# exclusive 1.9 s stays under the 2.0 s auto-fused threshold: the fused
+# baseline's extra XLA compile (~9 s/bucket on the tunnel) would eat a
+# short window; the micro number is exploratory and labeled as such by
+# its own exclusive_fused_steps_per_sec: 0.0
+if timeout 300 python bench.py --exclusive-seconds 1.9 --colocated-seconds 12 \
     --probe-timeout 45 > doc/bench-onchip-micro.json 2>> doc/bench-onchip.err
 then
   cat doc/bench-onchip-micro.json

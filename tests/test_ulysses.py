@@ -1,0 +1,99 @@
+"""All-to-all (Ulysses) sequence parallelism — the second long-context
+strategy (SURVEY TPU mandate: "ring attention or all-to-all
+sequence/context parallelism"). Same exactness bar as the ring tests:
+results AND gradients must match dense attention, on the 8-virtual-
+device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy: excluded from default lane
+from jax.sharding import Mesh
+
+from kubeshare_tpu.ops.attention import dot_product_attention
+from kubeshare_tpu.parallel.ringattention import make_ring_attention
+from kubeshare_tpu.parallel.ulysses import make_ulysses_attention
+
+
+def mesh3(dp=2, sp=4, tp=1):
+    devs = np.array(jax.devices("cpu")[:dp * sp * tp]).reshape(dp, sp, tp)
+    return Mesh(devs, ("dp", "sp", "tp"))
+
+
+def qkv(b=4, s=32, h=4, d=8, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), jnp.float32)
+                 for k in keys)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(causal):
+    q, k, v = qkv()
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = jax.jit(make_ulysses_attention(mesh3(), causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_matches_dense_heads_over_tp():
+    # heads ride tp AND the ulysses exchange splits the per-tp heads
+    q, k, v = qkv(b=2, s=16, h=8, d=8)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = jax.jit(make_ulysses_attention(mesh3(dp=2, sp=2, tp=2)))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_gradients_match_dense():
+    q, k, v = qkv(b=2, s=16, h=4, d=4)
+
+    def loss_via(attn_fn):
+        def f(q, k, v):
+            return (attn_fn(q, k, v) ** 2).mean()
+        return f
+
+    dense = jax.grad(loss_via(
+        lambda q, k, v: dot_product_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    uly = jax.jit(jax.grad(loss_via(make_ulysses_attention(mesh3())),
+                           argnums=(0, 1, 2)))(q, k, v)
+    for g_ref, g_uly in zip(dense, uly):
+        np.testing.assert_allclose(np.asarray(g_uly), np.asarray(g_ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_and_ring_are_interchangeable():
+    """Drop-in twins: identical signature, identical (exact) result —
+    the per-model choice is purely a perf/shape decision."""
+    q, k, v = qkv()
+    mesh = mesh3()
+    ring = jax.jit(make_ring_attention(mesh))(q, k, v)
+    uly = jax.jit(make_ulysses_attention(mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(ring),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    q, k, v = qkv(h=3)            # 3 heads over sp=4: no exchange
+    with pytest.raises(Exception, match="divisible|ring"):
+        jax.jit(make_ulysses_attention(mesh3()))(q, k, v)
+
+
+def test_ulysses_custom_attn_fn_owns_masking():
+    """A custom attn_fn owns ALL the attention math: combining it with
+    causal=True is rejected (silent un-masking footgun), and the
+    causal=False + baked-in-mask form matches dense."""
+    from functools import partial
+    q, k, v = qkv()
+    with pytest.raises(Exception, match="attn_fn's job"):
+        jax.jit(make_ulysses_attention(
+            mesh3(), causal=True,
+            attn_fn=partial(dot_product_attention, causal=True)))(q, k, v)
+    out = jax.jit(make_ulysses_attention(
+        mesh3(), causal=False,
+        attn_fn=partial(dot_product_attention, causal=True)))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
