@@ -118,8 +118,10 @@ def _write_partial(path: str | None, data: dict) -> None:
         with open(tmp, "w") as f:
             json.dump(data, f)
         os.replace(tmp, path)
-    except OSError:
-        pass
+    except OSError as exc:
+        # keep benching, but a silently-disabled partial file would lose
+        # the measured phases on the next wedge with no clue why
+        _mark(f"partial write failed ({exc}) — phase preservation is OFF")
 
 
 def _model(name: str):

@@ -17,11 +17,15 @@ max/sum/accumulator live in VMEM scratch and carry across the k steps
 masked causal blocks (k entirely above the diagonal) are predicated off
 with ``pl.when`` — the causal path does ~half the MXU work.
 
-Differentiable via ``custom_vjp``: the backward recomputes through the
-dense reference (O(seq²) peak on the BACKWARD only — fine at the
-sequence lengths a single chip trains; long-context training is the ring
-path's job). The public entry falls back to interpreter mode off-TPU, so
-CPU CI runs the identical kernel body.
+Differentiable via ``custom_vjp`` with FLASH BACKWARD kernels: the
+forward additionally emits the per-row logsumexp L, and the backward
+recomputes score blocks from (q, k, L) in VMEM — two Pallas kernels,
+one accumulating dQ over the k loop, one accumulating dK/dV over the q
+loop (separate kernels so each accumulator is owned by exactly one
+sequential grid lane — no cross-program races). Peak memory is
+O(block²) on the backward too, so long sequences train, not just
+infer. The public entry falls back to interpreter mode off-TPU, so CPU
+CI runs the identical kernel bodies.
 """
 
 from __future__ import annotations
@@ -34,13 +38,30 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import MASK_VALUE, dot_product_attention
+from .attention import MASK_VALUE
 
 BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _score_tile(q_ref, k_ref, j, kk, block_q, block_k, causal, scale):
+    """One (bq × bk) masked score tile — the ONLY place the score matmul
+    and causal mask live: the backward's P recompute must match the
+    forward's softmax bit-for-bit, so both call this."""
+    qs = q_ref[0].astype(jnp.float32) * scale
+    kb = k_ref[0].astype(jnp.float32)
+    sc = jax.lax.dot_general(qs, kb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if causal:
+        qpos = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        kpos = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        sc = jnp.where(qpos >= kpos, sc, MASK_VALUE)
+    return sc, qs, kb
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
             block_q: int, block_k: int, n_k: int, causal: bool,
             scale: float):
     """One (q-block, k-block) step. Scratch m/l/acc carry across the
@@ -62,18 +83,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(live)
     def _update():
-        qb = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
-        kb = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        sc, _qs, _kb = _score_tile(q_ref, k_ref, j, kk, block_q, block_k,
+                                   causal, scale)          # (bq, bk)
         vb = v_ref[0].astype(jnp.float32)
-        sc = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # (bq, bk)
-        if causal:
-            qpos = j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            kpos = kk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            sc = jnp.where(qpos >= kpos, sc, MASK_VALUE)
         m = m_ref[:]
         m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
         alpha = jnp.where(m > MASK_VALUE * 0.5, jnp.exp(m - m_new), 0.0)
@@ -89,6 +101,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l = l_ref[:]
         o_ref[0] = (acc_ref[:] / jnp.where(l > 0.0, l, 1.0)
                     ).astype(o_ref.dtype)
+        # per-row logsumexp: the backward recomputes P = exp(S - L)
+        # without re-running the online-softmax reduction
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.where(l > 0.0, l, 1.0))
 
 
 @functools.partial(jax.jit,
@@ -107,7 +122,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_kernel, block_q=bq, block_k=bk, n_k=n_k,
                           causal=causal, scale=scale),
         grid=(b * h, s // bq, n_k),
@@ -116,8 +131,14 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum
@@ -125,25 +146,166 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+
+
+def _recompute_p(q_ref, k_ref, lse_ref, j, kk, block_q, block_k, causal,
+                 scale):
+    """Shared by both backward kernels: rebuild one (bq × bk) probability
+    tile from q, k and the saved logsumexp — no running max needed.
+    Masked entries: exp(MASK_VALUE - L) underflows to exactly 0."""
+    sc, qs, kb = _score_tile(q_ref, k_ref, j, kk, block_q, block_k,
+                             causal, scale)
+    return jnp.exp(sc - lse_ref[0]), qs, kb
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
+                   dq_acc, *, block_q: int, block_k: int, n_k: int,
+                   causal: bool, scale: float):
+    """dQ pass: one q block owns the sequential k loop, so dq_acc has a
+    single writer. dS = P ∘ (dO·Vᵀ − D); dQ = scale · dS·K."""
+    j = pl.program_id(1)          # q block
+    kk = pl.program_id(2)         # k block (innermost, sequential)
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_end = (j + 1) * block_q - 1
+    live = jnp.logical_or(not causal, kk * block_k <= q_end)
+
+    @pl.when(live)
+    def _update():
+        p, _qs, kb = _recompute_p(q_ref, k_ref, lse_ref, j, kk,
+                                  block_q, block_k, causal, scale)
+        vb = v_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap_ref[0])
+        dq_acc[:] += scale * jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                    block_k: int, n_q: int, causal: bool, scale: float):
+    """dK/dV pass: one k block owns the sequential q loop. dV = Pᵀ·dO;
+    dK = scale · dSᵀ·(Q·scale)/scale = dSᵀ·Qs (Qs pre-scaled, so the
+    score scale is already inside)."""
+    jj = pl.program_id(1)         # k block
+    qq = pl.program_id(2)         # q block (innermost, sequential)
+
+    @pl.when(qq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Causal: a q block contributes iff its LAST query can see this k
+    # block's first key.
+    live = jnp.logical_or(not causal,
+                          (qq + 1) * block_q - 1 >= jj * block_k)
+
+    @pl.when(live)
+    def _update():
+        p, qs, _kb = _recompute_p(q_ref, k_ref, lse_ref, qq, jj,
+                                  block_q, block_k, causal, scale)
+        vb = v_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap_ref[0])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qq == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    n_q, n_k = s // bq, s // bk
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qr, kr, vr = fold(q), fold(k), fold(v)
+    dor = fold(g.astype(jnp.float32))
+    # D_i = rowsum(dO ∘ O): O(s·d) elementwise, XLA fuses it — not worth
+    # a kernel pass of its own.
+    dcap = (dor * fold(o)).sum(-1, keepdims=True)
+
+    qspec = pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0))
+    rowspec = pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk, n_k=n_k,
+                          causal=causal, scale=scale),
+        grid=(b * h, n_q, n_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, dcap)
+
+    # dK/dV grid: k blocks outer, q blocks inner (sequential) — indexers
+    # see (i, jj, qq).
+    qspec2 = pl.BlockSpec((1, bq, d), lambda i, jj, qq: (i, qq, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda i, jj, qq: (i, jj, 0))
+    rowspec2 = pl.BlockSpec((1, bq, 1), lambda i, jj, qq: (i, qq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk, n_q=n_q,
+                          causal=causal, scale=scale),
+        grid=(b * h, n_k, n_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, dcap)
+
+    def unfold(x):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return unfold(dq), unfold(dk), unfold(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    out, _lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret), \
-        (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dot_product_attention(q, k, v, causal=causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
+                      interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -7,7 +7,18 @@ the driver entry ``__graft_entry__.dryrun_multichip``); that module imports
 no jax at module scope, so it is safe to call pre-initialization here.
 """
 
+import os
+
 from kubeshare_tpu.utils.virtualcpu import force_virtual_cpu
 
 if not force_virtual_cpu(8):  # not an assert: -O must not skip the forcing
     raise RuntimeError("jax initialized before conftest could force CPU")
+
+# Subprocesses spawned by tests (workloads, proxies, rendezvous ranks)
+# inherit os.environ and must never dial the axon tunnel: one process
+# wedged on it blocks every other process's `import jax` at interpreter
+# startup (observed 2026-07-31 — a concurrent on-chip window exploit made
+# test_fullstack flake; doc/bench-notes.md). Tests are CPU-only by the
+# forcing above; dropping the trigger var makes every spawned interpreter
+# skip the tunnel registration entirely.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)

@@ -37,20 +37,51 @@ def test_flash_multiple_block_shapes():
                                    err_msg=f"bq={bq} bk={bk}")
 
 
-def test_flash_gradients_match_dense():
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gradients_match_dense(causal):
     q, k, v = qkv(s=32)
 
     def loss_ref(q, k, v):
-        return (dot_product_attention(q, k, v) ** 2).sum()
+        return (dot_product_attention(q, k, v, causal=causal) ** 2).sum()
 
     def loss_flash(q, k, v):
-        return (flash_attention(q, k, v, block_q=16, block_k=16) ** 2).sum()
+        return (flash_attention(q, k, v, causal=causal,
+                                block_q=16, block_k=16) ** 2).sum()
 
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_out, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_flash_gradients_asymmetric_blocks():
+    """The dQ pass loops k blocks, the dK/dV pass loops q blocks — bq≠bk
+    exercises both block indexers against the dense reference."""
+    q, k, v = qkv(s=64)
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v) * 0.5).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for bq, bk in ((8, 32), (32, 8)):
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, block_q=bq, block_k=bk)
+                    * 0.5).sum()
+        g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_out, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4,
+                                       err_msg=f"bq={bq} bk={bk}")
+
+
+def test_flash_gradient_dtypes_match_primals():
+    """custom_vjp cotangents must come back in the primal dtypes (bf16
+    params train without an accidental fp32 upcast in the grads)."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv(s=32))
+    g = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, block_q=16, block_k=16).sum(), argnums=(0, 1, 2))(q, k, v)
+    assert all(a.dtype == jnp.bfloat16 for a in g)
 
 
 def test_flash_rejects_ragged_blocks():
