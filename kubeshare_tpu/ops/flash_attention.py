@@ -106,26 +106,51 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         lse_ref[0] = m_ref[:] + jnp.log(jnp.where(l > 0.0, l, 1.0))
 
 
+def _fold(x):
+    """(b, s, h, d) → (b·h, s, d): one grid row per batch·head."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _vma(*xs):
+    """Varying-manual-axes union of the inputs: pallas outputs inside
+    ``shard_map`` (the ring composition) must declare how they vary."""
+    return frozenset().union(*(jax.typeof(x).vma for x in xs))
+
+
+def _blocks(s_q, s_kv, block_q, block_k, causal):
+    if causal and s_q != s_kv:
+        raise ValueError(f"causal needs equal q/kv lengths, got {s_q}/{s_kv}"
+                         " (mask positions are same-origin)")
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_kv)
+    if s_q % bq or s_kv % bk:
+        raise ValueError(f"seq q={s_q}/kv={s_kv} must be divisible by "
+                         f"blocks {bq}/{bk}")
+    return bq, bk
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    b, s, h, d = q.shape
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
     scale = 1.0 / math.sqrt(d)
-    bq = min(block_q, s)
-    bk = min(block_k, s)
-    if s % bq or s % bk:
-        raise ValueError(f"seq {s} must be divisible by blocks {bq}/{bk}")
-    n_k = s // bk
-    # (b, s, h, d) → (b·h, s, d): one grid row per batch·head.
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal)
+    n_k = s_kv // bk
+    qr, kr, vr = _fold(q), _fold(k), _fold(v)
+    vma = _vma(q, k, v)
 
     out, lse = pl.pallas_call(
         functools.partial(_kernel, block_q=bq, block_k=bk, n_k=n_k,
                           causal=causal, scale=scale),
-        grid=(b * h, s // bq, n_k),
+        grid=(b * h, s_q // bq, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
@@ -136,8 +161,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
@@ -146,7 +171,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+    return _unfold(out, b, h), lse
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, j, kk, block_q, block_k, causal,
@@ -236,21 +261,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
-def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
-    b, s, h, d = q.shape
+def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
+               interpret):
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
     scale = 1.0 / math.sqrt(d)
-    bq = min(block_q, s)
-    bk = min(block_k, s)
-    n_q, n_k = s // bq, s // bk
+    bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal)
+    n_q, n_k = s_q // bq, s_kv // bk
+    vma = _vma(q, k, v, o, lse, g)
 
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-    qr, kr, vr = fold(q), fold(k), fold(v)
-    dor = fold(g.astype(jnp.float32))
+    qr, kr, vr = _fold(q), _fold(k), _fold(v)
+    dor = _fold(g.astype(jnp.float32))
     # D_i = rowsum(dO ∘ O): O(s·d) elementwise, XLA fuses it — not worth
     # a kernel pass of its own.
-    dcap = (dor * fold(o)).sum(-1, keepdims=True)
+    dcap = (dor * _fold(o)).sum(-1, keepdims=True)
+    if g_lse is not None:
+        # lse output cotangent: ∂L_i/∂S_ij = P_ij, so the extra dS term
+        # P ∘ g_lse folds into the same kernels as dcap := D − g_lse
+        # (dS = P ∘ (dP − D + g_lse)).
+        dcap = dcap - (g_lse.astype(jnp.float32)
+                       .transpose(0, 2, 1).reshape(b * h, s_q, 1))
 
     qspec = pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0))
@@ -262,7 +292,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
         grid=(b * h, n_q, n_k),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dcap)
@@ -278,17 +308,14 @@ def _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
         grid=(b * h, n_k, n_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype, vma=vma),
+                   jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype, vma=vma)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dcap)
 
-    def unfold(x):
-        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-
-    return unfold(dq), unfold(dk), unfold(dv)
+    return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -304,11 +331,35 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k,
+    return _flash_bwd(q, k, v, out, lse, g, None, causal, block_q, block_k,
                       interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    b, s, h, _ = q.shape
+    return out, lse.reshape(b, h, s).transpose(0, 2, 1)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    b, s, h, _ = q.shape
+    return (out, lse.reshape(b, h, s).transpose(0, 2, 1)), \
+        (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    return _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q,
+                      block_k, interpret)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -326,3 +377,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     return _flash(q, k, v, causal, block_q, block_k, bool(interpret))
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True, block_q: int = BLOCK_Q,
+                        block_k: int = BLOCK_K,
+                        interpret: bool | None = None):
+    """:func:`flash_attention` that ALSO returns the per-row logsumexp
+    ``lse[b, i, h] = log Σ_j exp(q_i·k_j·scale)`` (fp32, masked keys
+    excluded). Partial attentions over disjoint key sets merge exactly::
+
+        lse = logaddexp(lse_a, lse_b)
+        out = out_a·exp(lse_a − lse) + out_b·exp(lse_b − lse)
+
+    — the composition :mod:`kubeshare_tpu.parallel.ringattention` uses
+    to run this kernel per ring step. Differentiable in both outputs
+    (the lse cotangent folds into the same backward kernels)."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash_lse(q, k, v, causal, block_q, block_k, bool(interpret))

@@ -90,25 +90,130 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
     return o / jnp.where(l > 0.0, l, 1.0)[..., None]
 
 
-def make_ring_attention(mesh: Mesh, causal: bool = True,
-                        axis_name: str = "sp"):
-    """An ``attn_fn(q, k, v)`` over GLOBAL (batch, seq, heads, head_dim)
-    arrays, sequence-sharded over ``axis_name`` via ``shard_map``.
+def ring_flash_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
+                               axis_name: str, causal: bool = True,
+                               block_q: int | None = None,
+                               block_k: int | None = None,
+                               interpret: bool | None = None) -> jax.Array:
+    """Ring attention whose per-step tile is the Pallas flash kernel.
 
-    Batch rides ``dp`` and heads ride ``tp`` when those axes exist in the
-    mesh (purely local — no collectives on them); sequence is the ring.
-    Plug the result into :func:`kubeshare_tpu.ops.attention.mha_apply`.
+    :func:`ring_attention_shard` bounds memory at O(block²) where block
+    = seq/sp — still quadratic IN THE SHARD, which at long context is
+    the limit (128k over sp=8 → a 16k×16k fp32 score tile per head).
+    Here each ring step instead calls
+    :func:`~kubeshare_tpu.ops.flash_attention.flash_attention_lse`, so
+    the largest live score tile is (block_q × block_k) VMEM-resident
+    REGARDLESS of shard length; partial outputs merge exactly via the
+    returned logsumexp. Two-level flash: the ring blocks the sequence
+    over chips (ICI), the kernel blocks the shard over VMEM.
+
+    The causal structure is hoisted OUT of the kernel: ring step i sees
+    global k-block (me − i) mod sp, which is entirely past (full
+    attention), the diagonal (causal attention), or entirely future
+    (skipped) — a 3-way ``lax.switch``, so the kernel never needs
+    dynamic position offsets.
     """
+    from ..ops.flash_attention import BLOCK_K, BLOCK_Q, flash_attention_lse
+
+    sp = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, nq, h, d = q.shape
+    # default to the kernel's VMEM tile sizes (clamped to the shard) —
+    # defaulting to nq would re-create the O(shard²) tile this exists
+    # to avoid
+    bq = min(BLOCK_Q, nq) if block_q is None else block_q
+    bk = min(BLOCK_K, nq) if block_k is None else block_k
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def tile_full(kblk, vblk):
+        return flash_attention_lse(q, kblk, vblk, causal=False,
+                                   block_q=bq, block_k=bk,
+                                   interpret=interpret)
+
+    def tile_diag(kblk, vblk):
+        return flash_attention_lse(q, kblk, vblk, causal=True,
+                                   block_q=bq, block_k=bk,
+                                   interpret=interpret)
+
+    def tile_masked(kblk, vblk):
+        # derived from q AND kblk/vblk so all switch branches carry the
+        # same varying-manual-axes type (plain constants have none)
+        zero = (kblk[0, 0, 0, 0].astype(jnp.float32) * 0.0
+                + vblk[0, 0, 0, 0].astype(jnp.float32) * 0.0)
+        return (q.astype(jnp.float32) * 0.0 + zero,
+                q.max(axis=-1).astype(jnp.float32) * 0.0 + zero
+                + MASK_VALUE)
+
+    def step(i, carry):
+        o, lse, kblk, vblk = carry
+        src = jnp.mod(me - i, sp)          # which global block we hold now
+        if causal:
+            branch = jnp.where(src < me, 0, jnp.where(src == me, 1, 2))
+            o_i, lse_i = lax.switch(branch, (tile_full, tile_diag,
+                                             tile_masked), kblk, vblk)
+        else:
+            o_i, lse_i = tile_full(kblk, vblk)
+        # exact merge of two normalized partials over disjoint key sets
+        lse_new = jnp.logaddexp(lse, lse_i)
+        wa = jnp.where(lse > MASK_VALUE * 0.5, jnp.exp(lse - lse_new), 0.0)
+        wb = jnp.where(lse_i > MASK_VALUE * 0.5,
+                       jnp.exp(lse_i - lse_new), 0.0)
+        o_new = o * wa[..., None] + o_i * wb[..., None]
+        kblk, vblk = lax.ppermute((kblk, vblk), axis_name, perm)
+        return o_new, lse_new, kblk, vblk
+
+    # accumulators derived from q: same varying-manual-axes type as the
+    # loop outputs (see ring_attention_shard)
+    o0 = q.astype(jnp.float32) * 0.0
+    lse0 = q.max(axis=-1).astype(jnp.float32) * 0.0 + MASK_VALUE
+    o, _, _, _ = lax.fori_loop(0, sp, step, (o0, lse0, k, v), unroll=True)
+    return o
+
+
+def _seq_shard_spec(mesh: Mesh, axis_name: str) -> P:
+    """The sequence-parallel layout both factories share: batch rides
+    ``dp`` and heads ride ``tp`` when those axes exist (purely local —
+    no collectives on them); sequence rides the ring axis."""
     names = set(mesh.axis_names)
     if axis_name not in names:
         raise ValueError(f"mesh {mesh.axis_names} has no {axis_name!r} axis")
-    bspec = "dp" if "dp" in names else None
-    hspec = "tp" if "tp" in names else None
-    spec = P(bspec, axis_name, hspec, None)
+    return P("dp" if "dp" in names else None, axis_name,
+             "tp" if "tp" in names else None, None)
+
+
+def make_ring_attention(mesh: Mesh, causal: bool = True,
+                        axis_name: str = "sp"):
+    """An ``attn_fn(q, k, v)`` over GLOBAL (batch, seq, heads, head_dim)
+    arrays, sequence-sharded over ``axis_name`` via ``shard_map``
+    (layout: :func:`_seq_shard_spec`). Plug the result into
+    :func:`kubeshare_tpu.ops.attention.mha_apply`.
+    """
+    spec = _seq_shard_spec(mesh, axis_name)
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec)
     def attn(q, k, v):
         return ring_attention_shard(q, k, v, axis_name, causal=causal)
+
+    return attn
+
+
+def make_ring_flash_attention(mesh: Mesh, causal: bool = True,
+                              axis_name: str = "sp",
+                              block_q: int | None = None,
+                              block_k: int | None = None,
+                              interpret: bool | None = None):
+    """:func:`make_ring_attention` with the Pallas flash kernel as the
+    per-step tile (see :func:`ring_flash_attention_shard`) — the
+    long-context configuration: O(block_q × block_k) live scores at
+    every level of the hierarchy."""
+    spec = _seq_shard_spec(mesh, axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec)
+    def attn(q, k, v):
+        return ring_flash_attention_shard(q, k, v, axis_name, causal=causal,
+                                          block_q=block_q, block_k=block_k,
+                                          interpret=interpret)
 
     return attn
