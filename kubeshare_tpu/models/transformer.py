@@ -120,15 +120,38 @@ def _loss_for_mesh(mesh):
     """Sequence-parallel loss when the gang's mesh carries an ``sp``
     axis (e.g. ``KUBESHARE_TPU_MESH="dp=2,sp=2,tp=2"``), dense
     otherwise (None = keep the default). Strategy is selectable via
-    ``KUBESHARE_TPU_SP_ATTN``: ``ring`` (default — any head count,
-    O((seq/sp)²) score memory) or ``ulysses`` (all-to-all head/sequence
-    exchange — two collectives total, needs heads divisible by sp; see
-    ``parallel/ulysses.py``)."""
+    ``KUBESHARE_TPU_SP_ATTN``:
+
+    - ``ring`` (default) — any head count, O((seq/sp)²) score memory;
+    - ``ring_flash`` — ring with the Pallas flash tile per step:
+      O(128²) live scores regardless of shard length (the long-context
+      default on the chip);
+    - ``ulysses`` — all-to-all head/sequence exchange, two collectives
+      total, needs heads divisible by sp;
+    - ``ulysses_flash`` — ulysses with the flash kernel as the local
+      attention body.
+    """
     if "sp" not in mesh.axis_names:
         return None
-    if os.environ.get("KUBESHARE_TPU_SP_ATTN", "ring").lower() == "ulysses":
+    kind = os.environ.get("KUBESHARE_TPU_SP_ATTN", "ring").lower()
+    if kind not in ("ring", "ring_flash", "ulysses", "ulysses_flash"):
+        # a typo must not silently wire in plain ring: on a long-context
+        # gang that's an O((seq/sp)²) tile and an OOM with no clue why
+        raise ValueError(
+            f"KUBESHARE_TPU_SP_ATTN={kind!r}: want ring | ring_flash | "
+            "ulysses | ulysses_flash")
+    if kind in ("ulysses", "ulysses_flash"):
         from ..parallel.ulysses import make_ulysses_attention
-        attn = make_ulysses_attention(mesh)
+        if kind == "ulysses_flash":
+            from ..ops.flash_attention import flash_attention
+            attn = make_ulysses_attention(
+                mesh, causal=False,
+                attn_fn=partial(flash_attention, causal=True))
+        else:
+            attn = make_ulysses_attention(mesh)
+    elif kind == "ring_flash":
+        from ..parallel.ringattention import make_ring_flash_attention
+        attn = make_ring_flash_attention(mesh)
     else:
         from ..parallel.ringattention import make_ring_attention
         attn = make_ring_attention(mesh)

@@ -31,7 +31,7 @@ from functools import partial
 
 import jax
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..ops.attention import dot_product_attention
 
@@ -85,13 +85,15 @@ def make_ulysses_attention(mesh: Mesh, causal: bool = True,
 
     Batch rides ``dp`` and heads ride ``tp`` when present; the ulysses
     exchange then needs ``heads/tp`` divisible by the ``sp`` size.
+
+    For long sequences pass the Pallas kernel as the local body::
+
+        make_ulysses_attention(mesh, causal=False,
+                               attn_fn=partial(flash_attention, causal=True))
     """
-    names = set(mesh.axis_names)
-    if axis_name not in names:
-        raise ValueError(f"mesh {mesh.axis_names} has no {axis_name!r} axis")
-    bspec = "dp" if "dp" in names else None
-    hspec = "tp" if "tp" in names else None
-    spec = P(bspec, axis_name, hspec, None)
+    from .ringattention import _seq_shard_spec
+
+    spec = _seq_shard_spec(mesh, axis_name)
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec)

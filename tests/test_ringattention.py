@@ -207,6 +207,35 @@ def test_transformer_sequence_parallel_matches_dense():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.parametrize("kind", ["ring", "ring_flash", "ulysses",
+                                  "ulysses_flash"])
+def test_sp_attn_selector_all_strategies_match_dense(monkeypatch, kind):
+    """KUBESHARE_TPU_SP_ATTN picks the sequence-parallel strategy the
+    gang runner wires in (MESH_HOOKS["loss"]); every choice must compute
+    the same loss as the dense single-device path."""
+    m = mesh3(dp=2, sp=4)
+    key = jax.random.PRNGKey(0)
+    params = small_init(key)
+    tokens, targets = small_batch(jax.random.fold_in(key, 1))
+    dense = float(transformer.loss_fn(params, (tokens, targets)))
+
+    monkeypatch.setenv("KUBESHARE_TPU_SP_ATTN", kind)
+    hook_loss = transformer.MESH_HOOKS["loss"](m)
+    assert hook_loss is not None
+    sh = NamedSharding(m, P("dp", "sp"))
+    batch = (jax.device_put(tokens, sh), jax.device_put(targets, sh))
+    loss = float(jax.jit(hook_loss)(params, batch))
+    assert loss == pytest.approx(dense, rel=2e-2), kind
+
+
+def test_sp_attn_selector_rejects_unknown_kind(monkeypatch):
+    """A typo (ring-flash, ringflash) must raise, not silently pick the
+    O((seq/sp)²) plain ring on a long-context gang."""
+    monkeypatch.setenv("KUBESHARE_TPU_SP_ATTN", "ring-flash")
+    with pytest.raises(ValueError, match="KUBESHARE_TPU_SP_ATTN"):
+        transformer.MESH_HOOKS["loss"](mesh3(dp=2, sp=4))
+
+
 def test_transformer_train_step_sp_grads_flow():
     """One optimizer step under dp x sp sharding: loss drops and every
     parameter receives a finite gradient through the ring."""

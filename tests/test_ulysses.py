@@ -37,6 +37,44 @@ def test_ulysses_matches_dense(causal):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_ulysses_flash_local_body_matches_dense():
+    """Ulysses with the Pallas flash kernel as the local attention —
+    the documented long-context configuration (all-to-all exchange,
+    then flash over the full sequence for this device's heads)."""
+    from functools import partial
+    from kubeshare_tpu.ops.flash_attention import flash_attention
+    q, k, v = qkv()
+    ref = dot_product_attention(q, k, v, causal=True)
+    ul = make_ulysses_attention(
+        mesh3(), causal=False,
+        attn_fn=partial(flash_attention, causal=True, block_q=8, block_k=8))
+    out = jax.jit(ul)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_flash_gradients_match_dense():
+    from functools import partial
+    from kubeshare_tpu.ops.flash_attention import flash_attention
+    q, k, v = qkv(s=16)
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+    ul = make_ulysses_attention(
+        mesh3(), causal=False,
+        attn_fn=partial(flash_attention, causal=True, block_q=4, block_k=4))
+
+    def loss_ul(q, k, v):
+        return (ul(q, k, v) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ul = jax.jit(jax.grad(loss_ul, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ul, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
 def test_ulysses_matches_dense_heads_over_tp():
     # heads ride tp AND the ulysses exchange splits the per-tp heads
     q, k, v = qkv(b=2, s=16, h=8, d=8)
