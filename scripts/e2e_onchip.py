@@ -1,19 +1,25 @@
 #!/usr/bin/env python
 """The SURVEY §7.2 minimum end-to-end slice, on the REAL chip.
 
-One chip proxy owns the TPU; two UNMODIFIED ``python -m
-kubeshare_tpu.models.mnist`` processes attach through environment
-variables alone (sitecustomize shim on PYTHONPATH — the reference's
-LD_PRELOAD contract, ``pkg/scheduler/pod.go:445-457``) at
+Phase 1 (gate mode, runs first — the pod must own a free chip): a
+whole-chip pod (request=1, limit=1) OWNS the real chip and is
+token-METERED through a pod manager against the per-chip token
+scheduler (gem-pmgr/gem-schd parity) — usage sampled from the manager
+proves real charging on the device.
+
+Phase 2 (proxy mode): one chip proxy owns the TPU; two UNMODIFIED
+``python -m kubeshare_tpu.models.mnist`` processes attach through
+environment variables alone (sitecustomize shim on PYTHONPATH — the
+reference's LD_PRELOAD contract, ``pkg/scheduler/pod.go:445-457``) at
 ``tpu_request=0.5`` each and train concurrently. Prints per-pod steps/s
 and the proxy's device-time split.
 
 Run from the repo root on a TPU host::
 
-    python scripts/e2e_onchip.py [--steps 200]
+    python scripts/e2e_onchip.py [--steps 200] [--skip-gate]
 
-Exit 0 iff both pods finish and the device-time split is within 10% of
-the requested 50/50.
+Exit 0 iff both proxy pods finish with a device-time split within 10%
+of the requested 50/50 AND the gate pod finishes charged.
 """
 
 from __future__ import annotations
@@ -30,11 +36,110 @@ SHIM = REPO / "kubeshare_tpu" / "_shim"
 sys.path.insert(0, str(REPO))
 
 
+def gate_phase(steps: int, timeout: float, platform: str = "") -> bool:
+    """Whole-chip gate-mode pod on the real chip (phase 1).
+
+    Runs BEFORE the proxy phase: the gate pod must OWN the device, so
+    this parent must not have initialized a jax backend yet (none of
+    the imports below touch jax). ``timeout`` bounds the WHOLE phase —
+    monitor and final wait share one deadline."""
+    import time
+
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.isolation import protocol
+    from kubeshare_tpu.isolation.podmgr import PodManager
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler, serve
+
+    deadline = time.monotonic() + timeout
+    sched_srv = serve(TokenScheduler())
+    sport = sched_srv.server_address[1]
+    mgr = PodManager("127.0.0.1", sport, "pod-gate", 1.0, 1.0)
+    mgr.serve()
+    print(f"gate: token scheduler on {sport}, pod manager on {mgr.port}",
+          flush=True)
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join([str(SHIM), str(REPO)]),
+        **{
+            C.ENV_ATTACH_MODE: "gate",
+            C.ENV_POD_MANAGER_PORT: str(mgr.port),
+            C.ENV_POD_NAME: "pod-gate",
+            C.ENV_TPU_REQUEST: "1.0",
+            C.ENV_TPU_LIMIT: "1.0",
+        },
+    )
+    cmd = [sys.executable, "-m", "kubeshare_tpu.models.mnist",
+           "--steps", str(steps)]
+    if platform:
+        # gate mode OWNS the device, so the rehearsal platform must be
+        # forced in the pod itself (proxy-mode pods never touch it)
+        cmd += ["--platform", platform]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(REPO))
+    used = 0.0
+    try:
+        with protocol.Connection("127.0.0.1", mgr.port) as conn:
+            conn.call({"op": "register"})
+            # charges land on the 10 s sliding window at renew time —
+            # sample DURING the run, plus once after exit (a short run's
+            # single charge lands at final release; the window has not
+            # expired yet)
+            while time.monotonic() < deadline and proc.poll() is None:
+                reply, _ = conn.call({"op": "usage"})
+                used = max(used, reply.get("used_ms", 0.0))
+                time.sleep(0.5)
+            out, _ = proc.communicate(
+                timeout=max(5.0, deadline - time.monotonic()))
+            reply, _ = conn.call({"op": "usage"})
+            used = max(used, reply.get("used_ms", 0.0))
+    except Exception as exc:
+        proc.kill()
+        print(f"gate: FAILED {type(exc).__name__}: {exc}", flush=True)
+        return False
+    finally:
+        mgr.close()
+        sched_srv.shutdown()
+        sched_srv.server_close()
+    line = [l for l in out.splitlines() if "steps/s" in l]
+    print(f"gate pod: rc={proc.returncode} {line[0] if line else ''} "
+          f"charged {used:.1f} ms device time", flush=True)
+    if proc.returncode != 0:
+        print(out[-1500:], flush=True)
+        return False
+    if used <= 0:
+        print("gate: FAILED — never charged the sliding window", flush=True)
+        return False
+    return True
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=200)
     parser.add_argument("--timeout", type=float, default=480.0)
+    parser.add_argument("--skip-gate", action="store_true",
+                        help="run only the proxy phase")
+    parser.add_argument("--platform", default="",
+                        help="force a JAX platform (e.g. 'cpu') for an "
+                             "off-chip rehearsal of the exact script the "
+                             "window sentry runs")
     args = parser.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu":
+            # subprocesses must not dial the axon tunnel either (a
+            # wedged tunnel blocks their import jax — doc/bench-notes.md)
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+
+    # Gate phase FIRST: its pod must own the device, and creating the
+    # ChipProxy below initializes this parent's jax backend (which on an
+    # exclusive-ownership TPU runtime would lock the gate pod out).
+    gate_ok = True
+    if not args.skip_gate:
+        gate_ok = gate_phase(args.steps, args.timeout, args.platform)
 
     from kubeshare_tpu import constants as C
     from kubeshare_tpu.isolation.proxy import ChipProxy
@@ -105,7 +210,8 @@ def main() -> int:
     share = max(split.values()) / total if total else 1.0
     print(f"device-time split: { {k: round(v, 1) for k, v in split.items()} }"
           f" -> max share {share:.3f} (target 0.5 ± 0.1)")
-    return 0 if ok and share <= 0.60 else 1
+    proxy_ok = ok and share <= 0.60
+    return 0 if proxy_ok and gate_ok else 1
 
 
 if __name__ == "__main__":

@@ -74,17 +74,17 @@ else
   fi
 fi
 
-echo "[$(stamp)] 2/3 e2e: two zero-touch pods on the real chip"
+echo "[$(stamp)] 2/3 e2e: two zero-touch proxy pods + a metered gate pod on the real chip"
 if ! probe_ok; then
   echo "[$(stamp)] tunnel wedged after bench — stopping (sentry resumes)"
   git add -A doc/ 2>/dev/null; git commit -qm "On-chip window logs" --no-verify || true
   exit 1
 fi
-if timeout 700 python scripts/e2e_onchip.py --steps 300 \
+if timeout 1200 python scripts/e2e_onchip.py --steps 300 \
     >> doc/e2e-onchip.log 2>&1; then
   tail -12 doc/e2e-onchip.log
   git add doc/e2e-onchip.log
-  git commit -qm "On-chip e2e: two zero-touch pods share the chip" \
+  git commit -qm "On-chip e2e: proxy-shared pods + metered gate pod" \
     --no-verify || true
 else
   echo "[$(stamp)] e2e failed mid-window:"; tail -8 doc/e2e-onchip.log
