@@ -34,10 +34,21 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Dense reference attention.
 
     ``q``: (batch, q_len, heads, head_dim); ``k``/``v``: (batch, kv_len,
-    heads, head_dim); returns (batch, q_len, heads, head_dim) in fp32.
+    kv_heads, head_dim); returns (batch, q_len, heads, head_dim) in fp32.
+    ``kv_heads`` may divide ``heads`` (grouped-query / multi-query
+    attention — each group of heads//kv_heads query heads shares one
+    k/v head); this reference expands k/v for clarity, the Pallas
+    kernel (:mod:`.flash_attention`) instead maps the group in its
+    block index arithmetic so the smaller k/v never grows in HBM.
     The ring implementation is validated against this function.
     """
     d = q.shape[-1]
+    h, hk = q.shape[2], k.shape[2]
+    if h != hk:
+        if h % hk:
+            raise ValueError(f"heads {h} not divisible by kv_heads {hk}")
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     scores = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
@@ -53,14 +64,23 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bqhk,bkhd->bqhd", weights, v.astype(jnp.float32))
 
 
-def mha_init(key, dim: int, heads: int) -> dict:
-    """Fused-QKV multi-head attention parameters (dim must divide heads)."""
+def mha_init(key, dim: int, heads: int, kv_heads: int | None = None) -> dict:
+    """Fused-QKV multi-head attention parameters (dim must divide heads).
+
+    ``kv_heads`` < ``heads`` builds a grouped-query / multi-query block:
+    the fused projection shrinks to (dim, dim + 2·kv_heads·head_dim) —
+    less weight memory AND a kv cache smaller by heads/kv_heads."""
     if dim % heads:
         raise ValueError(f"dim {dim} not divisible by heads {heads}")
+    kv_heads = heads if kv_heads is None else kv_heads
+    if heads % kv_heads:
+        raise ValueError(f"heads {heads} not divisible by kv_heads "
+                         f"{kv_heads}")
+    kvd = (dim // heads) * kv_heads
     kq, ko = jax.random.split(key)
     scale = math.sqrt(1.0 / dim)
     return {
-        "qkv": jax.random.uniform(kq, (dim, 3 * dim), jnp.float32,
+        "qkv": jax.random.uniform(kq, (dim, dim + 2 * kvd), jnp.float32,
                                   -scale, scale),
         "out": jax.random.uniform(ko, (dim, dim), jnp.float32,
                                   -scale, scale),
@@ -73,18 +93,22 @@ def mha_apply(params: dict, x: jax.Array, heads: int, causal: bool = True,
 
     ``attn_fn(q, k, v)`` defaults to causal :func:`dot_product_attention`;
     the sequence-parallel path passes a ring-attention closure instead.
+    The kv head count is read off the ``qkv`` weight's shape, so grouped-
+    query blocks (``mha_init(kv_heads=...)``) need no extra argument.
     """
     b, s, dim = x.shape
     hd = dim // heads
     w_qkv, w_out = params["qkv"], params["out"]
+    # (dim + 2·kvd) columns → kv_heads = kvd // head_dim
+    kvd = (w_qkv.shape[-1] - dim) // 2
+    kv_heads = kvd // hd
     if dtype is not None:
         x, w_qkv, w_out = (x.astype(dtype), w_qkv.astype(dtype),
                            w_out.astype(dtype))
-    qkv = x @ w_qkv                       # (b, s, 3*dim) — one MXU matmul
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, heads, hd)
-    k = k.reshape(b, s, heads, hd)
-    v = v.reshape(b, s, heads, hd)
+    qkv = x @ w_qkv            # (b, s, dim + 2·kvd) — one MXU matmul
+    q = qkv[..., :dim].reshape(b, s, heads, hd)
+    k = qkv[..., dim:dim + kvd].reshape(b, s, kv_heads, hd)
+    v = qkv[..., dim + kvd:].reshape(b, s, kv_heads, hd)
     if attn_fn is None:
         o = dot_product_attention(q, k, v, causal=causal)
     else:

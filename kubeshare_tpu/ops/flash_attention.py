@@ -135,14 +135,31 @@ def _blocks(s_q, s_kv, block_q, block_k, causal):
     return bq, bk
 
 
+def _kv_row_map(h, hk):
+    """Grid row (over batch·q-heads) → k/v array row (over batch·kv-heads).
+
+    Grouped-query attention lives HERE, not in an HBM expansion: q row
+    ``i = bi·h + hq`` reads k/v row ``bi·hk + hq // (h//hk)`` — the
+    group's shared k/v tile is simply addressed by every member's
+    programs, so the smaller k/v stays its small self in HBM (the point
+    of GQA: the kv bytes, not the FLOPs, bound long-context decode)."""
+    if h == hk:
+        return lambda i: i
+    if h % hk:
+        raise ValueError(f"heads {h} not divisible by kv_heads {hk}")
+    group = h // hk
+    return lambda i: (i // h) * hk + (i % h) // group
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     b, s_q, h, d = q.shape
-    s_kv = k.shape[1]
+    s_kv, hk = k.shape[1], k.shape[2]
     scale = 1.0 / math.sqrt(d)
     bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal)
+    kvrow = _kv_row_map(h, hk)
     n_k = s_kv // bk
     qr, kr, vr = _fold(q), _fold(k), _fold(v)
     vma = _vma(q, k, v)
@@ -153,8 +170,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         grid=(b * h, s_q // bq, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (kvrow(i), kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (kvrow(i), kk, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
@@ -219,14 +236,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                    block_k: int, n_q: int, causal: bool, scale: float):
-    """dK/dV pass: one k block owns the sequential q loop. dV = Pᵀ·dO;
-    dK = scale · dSᵀ·(Q·scale)/scale = dSᵀ·Qs (Qs pre-scaled, so the
-    score scale is already inside)."""
+                    block_k: int, n_q: int, group: int, causal: bool,
+                    scale: float):
+    """dK/dV pass: one K/V ROW (kv head) owns the sequential inner loop
+    ``t = g·n_q + qq`` over its GROUP of q heads × q blocks, so the GQA
+    group sum happens in the VMEM accumulator and the outputs stay
+    kv-sized in HBM (group=1 collapses to the plain per-head loop).
+    dV = Pᵀ·dO; dK = dSᵀ·Qs (Qs pre-scaled, so the score scale is
+    already inside)."""
     jj = pl.program_id(1)         # k block
-    qq = pl.program_id(2)         # q block (innermost, sequential)
+    t = pl.program_id(2)          # (q head in group, q block) — sequential
+    qq = t % n_q                  # q block index within the sequence
 
-    @pl.when(qq == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -252,7 +274,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
             ds, qs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qq == n_q - 1)
+    @pl.when(t == group * n_q - 1)
     def _finish():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -264,9 +286,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
 def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
                interpret):
     b, s_q, h, d = q.shape
-    s_kv = k.shape[1]
+    s_kv, hk = k.shape[1], k.shape[2]
     scale = 1.0 / math.sqrt(d)
     bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal)
+    kvrow = _kv_row_map(h, hk)
     n_q, n_k = s_q // bq, s_kv // bk
     vma = _vma(q, k, v, o, lse, g)
 
@@ -283,7 +306,7 @@ def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
                        .transpose(0, 2, 1).reshape(b * h, s_q, 1))
 
     qspec = pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0))
-    kspec = pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda i, j, kk: (kvrow(i), kk, 0))
     rowspec = pl.BlockSpec((1, bq, 1), lambda i, j, kk: (i, j, 0))
 
     dq = pl.pallas_call(
@@ -297,25 +320,37 @@ def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dcap)
 
-    # dK/dV grid: k blocks outer, q blocks inner (sequential) — indexers
-    # see (i, jj, qq).
-    qspec2 = pl.BlockSpec((1, bq, d), lambda i, jj, qq: (i, qq, 0))
-    kspec2 = pl.BlockSpec((1, bk, d), lambda i, jj, qq: (i, jj, 0))
-    rowspec2 = pl.BlockSpec((1, bq, 1), lambda i, jj, qq: (i, qq, 0))
+    # dK/dV grid: one row per batch·KV-head; k blocks outer; the
+    # sequential inner dim walks this kv head's whole GROUP of q heads ×
+    # q blocks (t = g·n_q + qq), so the group sum lives in the VMEM
+    # accumulator and dK/dV stay kv-sized in HBM. The q-side row for
+    # (i, t): batch (i // hk), q head (i % hk)·group + t // n_q.
+    group = h // hk
+
+    def qrow(i, t):
+        return (i // hk) * h + (i % hk) * group + t // n_q
+
+    qspec2 = pl.BlockSpec((1, bq, d),
+                          lambda i, jj, t: (qrow(i, t), t % n_q, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda i, jj, t: (i, jj, 0))
+    rowspec2 = pl.BlockSpec((1, bq, 1),
+                            lambda i, jj, t: (qrow(i, t), t % n_q, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk, n_q=n_q,
-                          causal=causal, scale=scale),
-        grid=(b * h, n_k, n_q),
+                          group=group, causal=causal, scale=scale),
+        grid=(b * hk, n_k, group * n_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s_kv, d), k.dtype, vma=vma),
-                   jax.ShapeDtypeStruct((b * h, s_kv, d), v.dtype, vma=vma)],
+        out_shape=[jax.ShapeDtypeStruct((b * hk, s_kv, d), k.dtype,
+                                        vma=vma),
+                   jax.ShapeDtypeStruct((b * hk, s_kv, d), v.dtype,
+                                        vma=vma)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dcap)
 
-    return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)
+    return _unfold(dq, b, h), _unfold(dk, b, hk), _unfold(dv, b, hk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -368,6 +403,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: bool | None = None) -> jax.Array:
     """Drop-in for :func:`~kubeshare_tpu.ops.attention.dot_product_attention`
     (same (batch, seq, heads, head_dim) layout, fp32 output).
+
+    Grouped-query / multi-query attention: pass k/v with ``kv_heads``
+    dividing q's ``heads`` — the group mapping happens in block index
+    arithmetic (``_kv_row_map``), so the smaller k/v is never expanded
+    in HBM.
 
     ``interpret=None`` auto-selects: compiled on TPU, interpreter
     elsewhere (the interpreter runs the identical kernel body, so CPU CI

@@ -45,6 +45,15 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
     sp = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, nq, h, d = q.shape
+    hk = k.shape[2]
+    if h != hk:
+        # grouped-query kv: the dense shard materializes the score tile
+        # anyway, so expanding kv here costs nothing extra (the flash
+        # ring maps the group in kernel index arithmetic instead)
+        if h % hk:
+            raise ValueError(f"heads {h} not divisible by kv_heads {hk}")
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     qf = q.astype(jnp.float32)
 

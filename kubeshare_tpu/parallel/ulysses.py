@@ -47,11 +47,13 @@ def ulysses_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
     queries' attention output, same shape, fp32.
     """
     sp = lax.axis_size(axis_name)
-    h = q.shape[2]
-    if h % sp:
+    h, hk = q.shape[2], k.shape[2]
+    if h % sp or hk % sp:
+        # both exchanges split a head axis across the group — grouped-
+        # query kv (hk < h) must still carry sp-divisible kv heads
         raise ValueError(
-            f"ulysses needs heads ({h}) divisible by sp ({sp}); "
-            f"use ring attention for this shape")
+            f"ulysses needs heads ({h}) AND kv_heads ({hk}) divisible "
+            f"by sp ({sp}); use ring attention for this shape")
     if attn_fn is not None and causal:
         # a custom body owns ALL the attention math, masking included —
         # silently un-masking a "causal=True" caller would be a footgun

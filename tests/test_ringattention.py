@@ -104,6 +104,34 @@ def test_ring_flash_gradients_match_dense():
                                    atol=1e-4, rtol=1e-4)
 
 
+def test_ring_dense_gqa_matches_dense():
+    """Grouped-query kv through the DENSE ring shard (kv expanded per
+    shard — the score tile is materialized there anyway)."""
+    q, _, _ = qkv(h=4)
+    kk, kv = jax.random.split(jax.random.PRNGKey(6))
+    k = jax.random.normal(kk, (4, 32, 2, 8), jnp.float32)
+    v = jax.random.normal(kv, (4, 32, 2, 8), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    ring = jax.jit(make_ring_attention(mesh3()))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_flash_gqa_matches_dense():
+    """Grouped-query kv (2 kv heads under 4 q heads) rides the ring
+    unchanged — the per-step flash tile owns the group mapping."""
+    from kubeshare_tpu.parallel.ringattention import make_ring_flash_attention
+    q, _, _ = qkv(h=4)
+    kk, kv = jax.random.split(jax.random.PRNGKey(5))
+    k = jax.random.normal(kk, (4, 32, 2, 8), jnp.float32)
+    v = jax.random.normal(kv, (4, 32, 2, 8), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    ring = jax.jit(make_ring_flash_attention(
+        mesh3(), block_q=4, block_k=4))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_flash_lse_merge_identity():
     """The documented merge recipe: attention over the full key set ==
     logsumexp-weighted merge of attentions over two disjoint halves."""

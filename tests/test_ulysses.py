@@ -37,6 +37,18 @@ def test_ulysses_matches_dense(causal):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_ulysses_rejects_kv_heads_not_divisible_by_sp():
+    """Multi-query kv (1 kv head) under sp=4 must fail with the
+    friendly error, not a low-level all_to_all divisibility crash."""
+    import pytest as _pytest
+    q, _, _ = qkv(h=4)
+    kk, kv = jax.random.split(jax.random.PRNGKey(6))
+    k = jax.random.normal(kk, (4, 32, 1, 8), jnp.float32)
+    v = jax.random.normal(kv, (4, 32, 1, 8), jnp.float32)
+    with _pytest.raises(ValueError, match="kv_heads"):
+        jax.jit(make_ulysses_attention(mesh3()))(q, k, v)
+
+
 def test_ulysses_flash_local_body_matches_dense():
     """Ulysses with the Pallas flash kernel as the local attention —
     the documented long-context configuration (all-to-all exchange,
