@@ -28,6 +28,26 @@ import jax.numpy as jnp
 MASK_VALUE = -1e30
 
 
+def kv_groups(heads: int, kv_heads: int) -> int:
+    """Query heads per k/v head (grouped-query attention). THE
+    divisibility check — every GQA entry point funnels through here."""
+    if heads % kv_heads:
+        raise ValueError(f"heads {heads} not divisible by kv_heads "
+                         f"{kv_heads}")
+    return heads // kv_heads
+
+
+def expand_kv(k: jax.Array, v: jax.Array, heads: int):
+    """Materialize grouped-query k/v to the full head count — the
+    CLARITY implementation for dense paths (the Pallas kernel instead
+    maps the group in block index arithmetic and never expands)."""
+    hk = k.shape[2]
+    if hk == heads:
+        return k, v
+    g = kv_groups(heads, hk)
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
                           scale: float | None = None) -> jax.Array:
@@ -43,12 +63,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     The ring implementation is validated against this function.
     """
     d = q.shape[-1]
-    h, hk = q.shape[2], k.shape[2]
-    if h != hk:
-        if h % hk:
-            raise ValueError(f"heads {h} not divisible by kv_heads {hk}")
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
+    k, v = expand_kv(k, v, q.shape[2])
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     scores = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
@@ -65,7 +80,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def mha_init(key, dim: int, heads: int, kv_heads: int | None = None) -> dict:
-    """Fused-QKV multi-head attention parameters (dim must divide heads).
+    """Fused-QKV multi-head attention parameters (heads must divide dim).
 
     ``kv_heads`` < ``heads`` builds a grouped-query / multi-query block:
     the fused projection shrinks to (dim, dim + 2·kv_heads·head_dim) —
@@ -73,9 +88,7 @@ def mha_init(key, dim: int, heads: int, kv_heads: int | None = None) -> dict:
     if dim % heads:
         raise ValueError(f"dim {dim} not divisible by heads {heads}")
     kv_heads = heads if kv_heads is None else kv_heads
-    if heads % kv_heads:
-        raise ValueError(f"heads {heads} not divisible by kv_heads "
-                         f"{kv_heads}")
+    kv_groups(heads, kv_heads)
     kvd = (dim // heads) * kv_heads
     kq, ko = jax.random.split(key)
     scale = math.sqrt(1.0 / dim)

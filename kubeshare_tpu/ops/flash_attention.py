@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import MASK_VALUE
+from .attention import MASK_VALUE, kv_groups
 
 BLOCK_Q = 128
 BLOCK_K = 128
@@ -145,9 +145,7 @@ def _kv_row_map(h, hk):
     of GQA: the kv bytes, not the FLOPs, bound long-context decode)."""
     if h == hk:
         return lambda i: i
-    if h % hk:
-        raise ValueError(f"heads {h} not divisible by kv_heads {hk}")
-    group = h // hk
+    group = kv_groups(h, hk)
     return lambda i: (i // h) * hk + (i % h) // group
 
 

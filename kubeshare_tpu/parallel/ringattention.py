@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import MASK_VALUE
+from ..ops.attention import MASK_VALUE, expand_kv, kv_groups
 
 
 def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -45,15 +45,8 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
     sp = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, nq, h, d = q.shape
-    hk = k.shape[2]
-    if h != hk:
-        # grouped-query kv: the dense shard materializes the score tile
-        # anyway, so expanding kv here costs nothing extra (the flash
-        # ring maps the group in kernel index arithmetic instead)
-        if h % hk:
-            raise ValueError(f"heads {h} not divisible by kv_heads {hk}")
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
+    if h != k.shape[2]:
+        kv_groups(h, k.shape[2])  # validate at trace time, expand per step
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     qf = q.astype(jnp.float32)
 
@@ -65,8 +58,12 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
     def step(i, carry):
         o, m, l, kblk, vblk = carry
         src = jnp.mod(me - i, sp)          # which global block we hold now
+        # grouped-query kv expands ONLY at the local einsum — the carry
+        # that rides the ring (ppermute below) stays kv-sized, so GQA's
+        # ICI-bandwidth saving survives the rotation
+        kb, vb = expand_kv(kblk, vblk, h)
         scores = jnp.einsum("bqhd,bkhd->bqhk", qf,
-                            kblk.astype(jnp.float32)) * scale
+                            kb.astype(jnp.float32)) * scale
         if causal:
             qidx = me * nq + jnp.arange(nq)
             kidx = src * nq + jnp.arange(nq)
@@ -82,7 +79,7 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
         l_new = l * alpha + p.sum(axis=-1)
         o_new = (o * alpha[..., None]
                  + jnp.einsum("bqhk,bkhd->bqhd", p,
-                              vblk.astype(jnp.float32)))
+                              vb.astype(jnp.float32)))
         kblk, vblk = lax.ppermute((kblk, vblk), axis_name, perm)
         return o_new, m_new, l_new, kblk, vblk
 

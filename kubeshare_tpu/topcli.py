@@ -1,0 +1,149 @@
+"""``kubeshare-top``: live fleet view from the telemetry registry.
+
+The reference has no operator console — fleet state lives across
+Prometheus queries and ``kubectl describe`` (``pkg/collector``,
+``pkg/aggregator``). Here the registry already holds both sides of the
+story (capacity from collectors, requirements from the scheduler bridge,
+``aggregator.go:22-39`` parity), so one read renders the whole fleet:
+per-chip bookings, free fractions, and the pods on each chip.
+
+Usage::
+
+    python -m kubeshare_tpu.topcli [--registry HOST:PORT] [--node N]
+                                   [--watch SECONDS] [--json]
+
+One-shot by default (script-friendly); ``--watch`` refreshes in place.
+Exit 0 on a healthy read, 2 when the registry is unreachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from . import constants as C
+
+
+def fetch(base: str, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def snapshot(base: str, node: str | None = None) -> dict:
+    """One coherent fleet view: capacity + pods joined per chip."""
+    capacity = fetch(base, "/capacity")
+    pods = fetch(base, "/pods")
+    if node is not None:
+        capacity = {n: v for n, v in capacity.items() if n == node}
+        pods = {k: v for k, v in pods.items() if v.get("node") == node}
+
+    now = time.time()
+    nodes = []
+    by_chip: dict[str, list] = {}
+    for key, rec in sorted(pods.items()):
+        for chip in filter(None, rec.get("chip_id", "").split(",")):
+            by_chip.setdefault(chip, []).append((key, rec))
+
+    total_chips = booked_total = 0
+    for name, entry in sorted(capacity.items()):
+        chips = []
+        for labels in entry.get("chips", []):
+            cid = labels.get("chip_id", "?")
+            residents = by_chip.get(cid, [])
+            # a fractional pod books its request on its one chip; a
+            # whole-chip (integer) pod books 1.0 on EACH listed chip
+            booked = sum(min(float(r.get("request", 0) or 0), 1.0)
+                         for _, r in residents)
+            chips.append({
+                "chip_id": cid,
+                "model": labels.get("model", "?"),
+                "memory_gib": int(labels.get("memory", 0) or 0) >> 30,
+                "coords": labels.get("coords", ""),
+                "booked": round(booked, 3),
+                "free": round(max(0.0, 1.0 - booked), 3),
+                "pods": [{"key": k,
+                          "request": r.get("request", "?"),
+                          "limit": r.get("limit", "?"),
+                          "priority": r.get("priority", "0"),
+                          "group": r.get("group_name", "")}
+                         for k, r in residents],
+            })
+            total_chips += 1
+            booked_total += booked
+        nodes.append({"node": name,
+                      "healthy": bool(entry.get("healthy", True)),
+                      "age_s": round(now - entry.get("ts", now), 1),
+                      "chips": chips})
+    groups = {r.get("group_name") for r in pods.values()
+              if r.get("group_name")}
+    return {"nodes": nodes,
+            "fleet": {"chips": total_chips,
+                      "booked": round(booked_total, 3),
+                      "pods": len(pods), "gangs": len(groups)}}
+
+
+def render(snap: dict) -> str:
+    lines = []
+    for n in snap["nodes"]:
+        state = "healthy" if n["healthy"] else "UNHEALTHY"
+        lines.append(f"{n['node']}  ({state}, {len(n['chips'])} chips, "
+                     f"capacity age {n['age_s']}s)")
+        for c in n["chips"]:
+            residents = ", ".join(
+                f"{p['key']}({p['request']}/{p['limit']}"
+                + (f" g={p['group']}" if p["group"] else "")
+                + (" opp" if p["priority"] == "0" else "") + ")"
+                for p in c["pods"]) or "-"
+            lines.append(
+                f"  {c['chip_id']:<28} {c['model']:<12} "
+                f"{c['memory_gib']:>3}G  booked {c['booked']:<5} "
+                f"free {c['free']:<5} {residents}")
+    f = snap["fleet"]
+    pct = 100.0 * f["booked"] / f["chips"] if f["chips"] else 0.0
+    lines.append(f"FLEET: {f['chips']} chips, {f['booked']}/{f['chips']} "
+                 f"booked ({pct:.0f}%), {f['pods']} pods, "
+                 f"{f['gangs']} gangs")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeshare-top",
+                                     description=__doc__)
+    parser.add_argument("--registry",
+                        default=f"127.0.0.1:{C.REGISTRY_PORT}",
+                        help="registry HOST:PORT (default: the well-known "
+                             "service port, deploy/registry.yaml)")
+    parser.add_argument("--node", default=None,
+                        help="show one node only")
+    parser.add_argument("--watch", type=float, default=0.0,
+                        help="refresh every N seconds (0 = one shot)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable snapshot instead of a table")
+    args = parser.parse_args(argv)
+    base = ("http://" + args.registry if "://" not in args.registry
+            else args.registry)
+
+    while True:
+        try:
+            snap = snapshot(base, args.node)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print(f"kubeshare-top: registry {args.registry} unreachable: "
+                  f"{exc}", file=sys.stderr)
+            return 2
+        out = json.dumps(snap) if args.json else render(snap)
+        if args.watch > 0:
+            # clear + home, then the frame — the classic top refresh
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            time.sleep(args.watch)
+        else:
+            print(out)
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""kubeshare-top: the operator fleet console over a live registry."""
+
+import json
+
+from kubeshare_tpu import topcli
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+
+def serve_fleet():
+    reg = TelemetryRegistry()
+    chips = FakeTopology(hosts=2, mesh=(2,)).chips()
+    by_host: dict = {}
+    for c in chips:
+        by_host.setdefault(c.host, []).append(c.to_labels())
+    for host, labels in by_host.items():
+        reg.put_capacity(host, labels)
+    first = by_host["tpu-host-0"][0]["chip_id"]
+    reg.put_pod("ns/a", {"node": "tpu-host-0", "chip_id": first,
+                         "request": "0.5", "limit": "1.0", "priority": "1",
+                         "group_name": ""})
+    reg.put_pod("ns/b", {"node": "tpu-host-0", "chip_id": first,
+                         "request": "0.5", "limit": "0.5", "priority": "0",
+                         "group_name": "g1"})
+    srv = reg.serve()
+    return reg, srv, first
+
+
+def test_snapshot_joins_capacity_and_pods():
+    reg, srv, first = serve_fleet()
+    try:
+        snap = topcli.snapshot(f"http://127.0.0.1:{srv.server_address[1]}")
+        assert snap["fleet"] == {"chips": 4, "booked": 1.0, "pods": 2,
+                                 "gangs": 1}
+        node0 = next(n for n in snap["nodes"] if n["node"] == "tpu-host-0")
+        chip = next(c for c in node0["chips"] if c["chip_id"] == first)
+        assert chip["booked"] == 1.0 and chip["free"] == 0.0
+        assert {p["key"] for p in chip["pods"]} == {"ns/a", "ns/b"}
+        empty = next(c for c in node0["chips"] if c["chip_id"] != first)
+        assert empty["booked"] == 0.0 and empty["pods"] == []
+    finally:
+        srv.shutdown()
+
+
+def test_cli_renders_and_filters(capsys):
+    reg, srv, first = serve_fleet()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        assert topcli.main(["--registry", addr]) == 0
+        out = capsys.readouterr().out
+        assert first in out and "FLEET: 4 chips" in out
+        assert "g=g1" in out and "opp" in out
+
+        assert topcli.main(["--registry", addr, "--node", "tpu-host-1",
+                            "--json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert [n["node"] for n in snap["nodes"]] == ["tpu-host-1"]
+        assert snap["fleet"]["pods"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_cli_unreachable_registry_exits_2(capsys):
+    assert topcli.main(["--registry", "127.0.0.1:1"]) == 2
+    assert "unreachable" in capsys.readouterr().err

@@ -37,6 +37,19 @@ def test_ulysses_matches_dense(causal):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_ulysses_gqa_matches_dense():
+    """Accepted GQA path: h=8 q heads, hk=4 kv heads, both divisible by
+    sp=4 — the head-axis all_to_all chunks the SMALLER kv head count."""
+    q, _, _ = qkv(h=8)
+    kk, kv = jax.random.split(jax.random.PRNGKey(6))
+    k = jax.random.normal(kk, (4, 32, 4, 8), jnp.float32)
+    v = jax.random.normal(kv, (4, 32, 4, 8), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = jax.jit(make_ulysses_attention(mesh3()))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_ulysses_rejects_kv_heads_not_divisible_by_sp():
     """Multi-query kv (1 kv head) under sp=4 must fail with the
     friendly error, not a low-level all_to_all divisibility crash."""
