@@ -23,23 +23,18 @@ import json
 import sys
 import time
 import urllib.error
-import urllib.request
 
 from . import constants as C
+from .telemetry.registry import RegistryClient
 
 
-def fetch(base: str, path: str, timeout: float = 5.0):
-    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
-        return json.loads(resp.read())
-
-
-def snapshot(base: str, node: str | None = None) -> dict:
-    """One coherent fleet view: capacity + pods joined per chip."""
-    capacity = fetch(base, "/capacity")
-    pods = fetch(base, "/pods")
+def snapshot(client: RegistryClient, node: str | None = None) -> dict:
+    """One coherent fleet view: capacity + pods joined per chip (pods
+    filtered server-side via ``/pods?node=``)."""
+    capacity = client.capacity()
+    pods = client.pods(node)
     if node is not None:
         capacity = {n: v for n, v in capacity.items() if n == node}
-        pods = {k: v for k, v in pods.items() if v.get("node") == node}
 
     now = time.time()
     nodes = []
@@ -86,6 +81,15 @@ def snapshot(base: str, node: str | None = None) -> dict:
                       "pods": len(pods), "gangs": len(groups)}}
 
 
+def _opportunistic(priority: str) -> bool:
+    """Match the scheduler's rule: priority <= 0 is opportunistic
+    (``scheduler/labels.py``), not just the literal "0"."""
+    try:
+        return int(priority) <= 0
+    except (TypeError, ValueError):
+        return False
+
+
 def render(snap: dict) -> str:
     lines = []
     for n in snap["nodes"]:
@@ -96,7 +100,7 @@ def render(snap: dict) -> str:
             residents = ", ".join(
                 f"{p['key']}({p['request']}/{p['limit']}"
                 + (f" g={p['group']}" if p["group"] else "")
-                + (" opp" if p["priority"] == "0" else "") + ")"
+                + (" opp" if _opportunistic(p["priority"]) else "") + ")"
                 for p in c["pods"]) or "-"
             lines.append(
                 f"  {c['chip_id']:<28} {c['model']:<12} "
@@ -124,25 +128,31 @@ def main(argv=None) -> int:
     parser.add_argument("--json", action="store_true",
                         help="machine-readable snapshot instead of a table")
     args = parser.parse_args(argv)
-    base = ("http://" + args.registry if "://" not in args.registry
-            else args.registry)
+    host, _, port = args.registry.rpartition(":")
+    client = RegistryClient(host or "127.0.0.1", int(port))
 
-    while True:
-        try:
-            snap = snapshot(base, args.node)
-        except (urllib.error.URLError, OSError, ValueError) as exc:
-            print(f"kubeshare-top: registry {args.registry} unreachable: "
-                  f"{exc}", file=sys.stderr)
-            return 2
-        out = json.dumps(snap) if args.json else render(snap)
-        if args.watch > 0:
-            # clear + home, then the frame — the classic top refresh
-            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
-            sys.stdout.flush()
-            time.sleep(args.watch)
-        else:
-            print(out)
-            return 0
+    try:
+        while True:
+            try:
+                snap = snapshot(client, args.node)
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                print(f"kubeshare-top: registry {args.registry} "
+                      f"unreachable: {exc}", file=sys.stderr)
+                return 2
+            out = json.dumps(snap) if args.json else render(snap)
+            if args.watch > 0:
+                if args.json:
+                    print(out, flush=True)  # one parseable frame per line
+                else:
+                    # clear + home, then the frame — the classic refresh
+                    sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+                    sys.stdout.flush()
+                time.sleep(args.watch)
+            else:
+                print(out)
+                return 0
+    except KeyboardInterrupt:
+        return 0  # ctrl-c is how --watch exits; not an error
 
 
 if __name__ == "__main__":

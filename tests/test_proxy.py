@@ -121,6 +121,49 @@ def test_training_loop_through_proxy(proxy):
         assert u["exec_ms_total"] > 0
 
 
+@pytest.mark.slow  # XLA-compile-heavy: transformer chunk + pallas export
+def test_transformer_flash_trains_through_proxy(proxy):
+    """The long-context family rides the sharing runtime: a transformer
+    train chunk whose attention is the PALLAS FLASH KERNEL ships through
+    the proxy's fused-loop path (jax.export round-trip included) and
+    converges — the two halves of the framework in one test."""
+    import optax
+
+    from kubeshare_tpu.models import transformer
+    from kubeshare_tpu.ops.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    params = transformer.init(key, seq_len=32, vocab=64, dim=32, layers=1)
+    tokens = np.asarray(jax.random.randint(
+        jax.random.fold_in(key, 1), (2, 33), 0, 64))
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    optimizer = optax.adam(1e-2)
+    flash = lambda q, k, v: flash_attention(q, k, v, block_q=16,
+                                            block_k=16)
+
+    def train_chunk(carry, xb, yb):
+        p, opt = carry
+        loss, grads = jax.value_and_grad(
+            lambda p: transformer.loss_fn(p, (xb, yb), attn_fn=flash))(p)
+        updates, opt = optimizer.update(grads, opt, p)
+        return (optax.apply_updates(p, updates), opt), loss
+
+    with connect(proxy, "lc-trainer") as c:
+        carry = (c.put_tree(jax.tree_util.tree_map(np.asarray, params)),
+                 c.put_tree(jax.tree_util.tree_map(
+                     np.asarray, optimizer.init(params))))
+        bx, by = c.put(batch[0]), c.put(batch[1])
+        loop = c.compile_loop(train_chunk, carry, bx, by)
+        carry, first = loop(1, carry, bx, by)
+        l0 = float(c.get(first))
+        for _ in range(4):
+            carry, loss = loop(10, carry, bx, by)
+            c.free(loss)
+        carry, last = loop(1, carry, bx, by)
+        assert float(c.get(last)) < l0
+        assert c.usage()["exec_ms_total"] > 0
+
+
 def test_session_is_connection_bound(proxy):
     """A connection can only act on the session it registered (no quota /
     buffer theft by naming another client)."""

@@ -27,9 +27,11 @@ def serve_fleet():
 
 
 def test_snapshot_joins_capacity_and_pods():
+    from kubeshare_tpu.telemetry.registry import RegistryClient
     reg, srv, first = serve_fleet()
     try:
-        snap = topcli.snapshot(f"http://127.0.0.1:{srv.server_address[1]}")
+        snap = topcli.snapshot(
+            RegistryClient("127.0.0.1", srv.server_address[1]))
         assert snap["fleet"] == {"chips": 4, "booked": 1.0, "pods": 2,
                                  "gangs": 1}
         node0 = next(n for n in snap["nodes"] if n["node"] == "tpu-host-0")
