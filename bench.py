@@ -289,7 +289,8 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
               exclusive_fused: bool | None = None,
               window_ms: float | None = None,
               model: str = "mnist",
-              partial_path: str | None = None) -> dict:
+              partial_path: str | None = None,
+              skip_plain: bool = False) -> dict:
     import jax
 
     _enable_persistent_compile_cache()
@@ -311,8 +312,15 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
                "model": model}
     _write_partial(partial_path, partial)
 
-    exclusive_plain = _exclusive_steps_per_sec(exclusive_s, model=model)
-    _mark(f"exclusive plain: {exclusive_plain:.2f} steps/s")
+    if skip_plain:
+        # tunnel windows are scarce: the plain per-step loop costs ~1 min
+        # of window (compile + 68 ms/dispatch) and never wins the
+        # max(plain, fused) denominator on the chip — informative only
+        exclusive_plain = 0.0
+        _mark("exclusive plain: skipped (--skip-plain)")
+    else:
+        exclusive_plain = _exclusive_steps_per_sec(exclusive_s, model=model)
+        _mark(f"exclusive plain: {exclusive_plain:.2f} steps/s")
     partial.update(phase="exclusive_fused",
                    exclusive_plain_steps_per_sec=round(exclusive_plain, 2))
     _write_partial(partial_path, partial)
@@ -321,7 +329,9 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
     # any run whose ratio is REPORTED must pay it, or the co-located
     # side's dispatch amortization inflates the ratio.
     if exclusive_fused is None:
-        exclusive_fused = exclusive_s >= 2.0
+        # with plain skipped the fused baseline IS the denominator —
+        # never auto-skip it too
+        exclusive_fused = True if skip_plain else exclusive_s >= 2.0
     exclusive_fused_sps = (_exclusive_steps_per_sec(exclusive_s,
                                                     fused_chunk=chunk,
                                                     model=model)
@@ -375,7 +385,9 @@ def run_bench(exclusive_s: float, colocated_s: float, chunk: int = 100,
         "unit": "fraction",
         "vs_baseline": round(ratio / 0.90, 4),
         "exclusive_steps_per_sec": round(exclusive_sps, 2),
-        "exclusive_plain_steps_per_sec": round(exclusive_plain, 2),
+        # None = phase skipped (distinguishable from a measured zero)
+        "exclusive_plain_steps_per_sec": (None if skip_plain
+                                          else round(exclusive_plain, 2)),
         "exclusive_fused_steps_per_sec": round(exclusive_fused_sps, 2),
         "colocated_aggregate_steps_per_sec": round(aggregate_sps, 2),
         "client_steps_per_sec": [round(a["steps_per_sec"], 2),
@@ -418,6 +430,10 @@ def main(argv=None) -> int:
     parser.add_argument("--partial-file", default=None,
                         help="path that accumulates per-phase results so a "
                              "mid-run tunnel wedge keeps the measured phases")
+    parser.add_argument("--skip-plain", action="store_true",
+                        help="skip the naive per-step exclusive baseline "
+                             "(the fused baseline is the honest denominator "
+                             "on-chip; saves ~1 min of a scarce window)")
     args = parser.parse_args(argv)
     if args.partial_file is None:
         args.partial_file = str(Path(__file__).resolve().parent
@@ -517,7 +533,8 @@ def main(argv=None) -> int:
     try:
         result = run_bench(args.exclusive_seconds, args.colocated_seconds,
                            args.chunk, model=args.model,
-                           partial_path=args.partial_file)
+                           partial_path=args.partial_file,
+                           skip_plain=args.skip_plain)
     except Exception as exc:  # one diagnostic line, not a 40-line traceback
         print(json.dumps({"metric": "colocated_2x0.5_aggregate_ratio",
                           "value": 0.0, "unit": "fraction",

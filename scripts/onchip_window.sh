@@ -56,7 +56,8 @@ echo "[$(stamp)] HEALTHY — north-star bench first (the headline artifact)"
 
 echo "[$(stamp)] 1/3 north-star bench (full knobs; ~2 min warm-cache)"
 if timeout 900 python bench.py --exclusive-seconds 5 --colocated-seconds 35 \
-    --probe-timeout 45 > BENCH_ONCHIP.json 2>> doc/bench-onchip.err; then
+    --skip-plain --probe-timeout 45 \
+    > BENCH_ONCHIP.json 2>> doc/bench-onchip.err; then
   cat BENCH_ONCHIP.json
   # partial is a byte-duplicate of the result on success — headline only;
   # remove it so the final catch-all doesn't commit it as flapped data
