@@ -133,6 +133,21 @@ class KubeClient:
             "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}",
             body=body, content_type="application/merge-patch+json").close()
 
+    def delete_pod(self, namespace: str, name: str, uid: str = "") -> None:
+        """Evict a pod (preemption). ``uid`` becomes a server-side
+        precondition so a recreated same-name pod is never the one
+        killed. 404 (already gone) and 409 (uid mismatch — the targeted
+        incarnation is gone) both count as success."""
+        body = {"preconditions": {"uid": uid}} if uid else None
+        try:
+            self._request(
+                "DELETE",
+                f"/api/v1/namespaces/{namespace}/pods/{name}",
+                body=body).close()
+        except urllib.error.HTTPError as e:
+            if e.code not in (404, 409):
+                raise
+
     def bind(self, namespace: str, name: str, node: str,
              uid: str = "") -> None:
         body = {
@@ -183,6 +198,12 @@ class ServiceClient:
                           {"namespace": namespace, "name": name,
                            "labels": labels, "annotations": annotations,
                            "node": node, "uid": uid})
+
+    def evictions(self) -> list[dict]:
+        code, body = self._call("GET", "/evictions")
+        if code != 200:
+            raise RuntimeError(f"/evictions returned {code}")
+        return body.get("evictions", [])
 
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
@@ -235,6 +256,9 @@ class PodEventBridge:
         # later with no pod event to wake us, so a poller watches their
         # status and performs the deferred write-back
         self._awaiting: dict[str, tuple[str, str, str]] = {}
+        # victims already deleted on the API this incarnation (dedupe:
+        # the scheduler keeps requesting until it OBSERVES the deletion)
+        self._evicted: set[str] = set()
 
     # -- event handling ------------------------------------------------------
 
@@ -294,6 +318,37 @@ class PodEventBridge:
         self._settled.add(key)
         self._awaiting.pop(key, None)
         log.info("pod %s bound to %s", key, result["node"])
+
+    def execute_evictions(self) -> None:
+        """Carry out the dispatcher's preemption plans: delete each
+        requested victim on the API server (a guarantee pod displacing
+        opportunistic filler). The victim's DELETED watch event then
+        releases its booking through the normal path, and the preemptor
+        binds on a later dispatcher cycle. Deletes are deduped per
+        incarnation; the request list itself converges server-side once
+        the victim is observed gone."""
+        try:
+            requests = self.service.evictions()
+        except Exception as e:
+            log.warning("eviction fetch failed: %s", e)
+            return
+        for req in requests:
+            key = req.get("victim", "")
+            if not key or key in self._evicted:
+                continue
+            ns, _, name = key.partition("/")
+            try:
+                self.kube.delete_pod(ns, name, uid=req.get("uid", ""))
+            except Exception as e:
+                log.warning("eviction of %s failed (will retry): %s",
+                            key, e)
+                continue
+            self._evicted.add(key)
+            log.info("evicted %s (preempted by %s)",
+                     key, req.get("preemptor", "?"))
+        # dedupe entries expire once the scheduler stops requesting them
+        live = {r.get("victim") for r in requests}
+        self._evicted &= live
 
     def poll_pending(self) -> None:
         """Write back pods the dispatcher bound after their 202: a gang
@@ -405,6 +460,7 @@ class PodEventBridge:
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_s):
+            self.execute_evictions()
             self.poll_pending()
 
     def start(self) -> "PodEventBridge":

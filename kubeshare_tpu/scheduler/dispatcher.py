@@ -94,6 +94,10 @@ class Dispatcher:
         self._parked: dict[str, _Parked] = {}
         self._results: dict[str, Outcome] = {}
         self._last_reason: dict[str, str] = {}
+        #: eviction requests from preemption plans (victim key → detail);
+        #: served via /evictions, executed by the bridge (API delete),
+        #: completed by the victim's normal DELETED event
+        self._evict_requested: dict[str, dict] = {}
         self._next_gc = 0.0
         self._stop = False
         self._thread: threading.Thread | None = None
@@ -217,6 +221,26 @@ class Dispatcher:
                 self._cycle(pod, now)
                 progressed = True
 
+        # AFTER the pass (same-step binds must take effect immediately —
+        # the bridge polls between steps): eviction requests complete
+        # when the victim leaves the engine (its DELETED event ran
+        # delete()) or was REPLACED (same key, new uid — a controller
+        # recreated it; the old incarnation is gone, the new one is
+        # innocent), and are CANCELLED when the preemptor no longer
+        # needs them (bound, or deleted) — a stale request must never
+        # kill filler for a satisfied pod.
+        for key, req in list(self._evict_requested.items()):
+            victim = self.engine.pod_status.get(key)
+            if victim is None or victim.uid != req.get("uid", victim.uid):
+                del self._evict_requested[key]
+                continue
+            pre = self.engine.pod_status.get(req.get("preemptor", ""))
+            if pre is None or pre.node_name:
+                log.info("eviction of %s cancelled (preemptor %s %s)",
+                         key, req.get("preemptor"),
+                         "bound" if pre is not None else "gone")
+                del self._evict_requested[key]
+
         nxt = self._next_gc
         for parked in self._parked.values():
             nxt = min(nxt, parked.deadline)
@@ -244,6 +268,8 @@ class Dispatcher:
         try:
             binding = self.engine.schedule(pod)
         except Unschedulable as e:
+            if self._maybe_preempt(pod, now):
+                return
             self._requeue(pod, now, str(e))
             return
         if self.registry is not None and pod.needs_tpu:
@@ -270,6 +296,41 @@ class Dispatcher:
                         if p.pod.group_key == pod.group_key]:
                 parked = self._parked.pop(key)
                 self._resolve(key, Outcome("bound", binding=parked.binding))
+
+    def _maybe_preempt(self, pod: PodRequest, now: float) -> bool:
+        """A blocked guarantee pod may displace opportunistic pods
+        (engine.find_preemption). The plan only REQUESTS evictions — the
+        control plane deletes the victims on the API server, their
+        DELETED events reclaim the bookings, and this pod binds on a
+        later cycle. Returns True when a plan was adopted."""
+        plan = self.engine.find_preemption(pod)
+        if plan is None:
+            return False
+        # this preemptor's previous plan may have shifted (capacity moved
+        # between retries) — keep only the victims the CURRENT plan needs
+        for key, req in list(self._evict_requested.items()):
+            if (req.get("preemptor") == pod.key
+                    and key not in plan["victims"]):
+                del self._evict_requested[key]
+        fresh = [k for k in plan["victims"]
+                 if k not in self._evict_requested]
+        for key in fresh:
+            victim = self.engine.pod_status.get(key)
+            self._evict_requested[key] = {
+                "victim": key, "preemptor": pod.key, "node": plan["node"],
+                "uid": victim.uid if victim is not None else ""}
+        if fresh:
+            log.info("%s preempts %d opportunistic pod(s) on %s: %s",
+                     pod.key, len(fresh), plan["node"], ", ".join(fresh))
+        self._requeue(pod, now,
+                      f"preempting {len(plan['victims'])} opportunistic "
+                      f"pod(s) on {plan['node']}")
+        return True
+
+    def evictions(self) -> list[dict]:
+        """Outstanding eviction requests (victims not yet observed gone)."""
+        with self._cond:
+            return [dict(v) for v in self._evict_requested.values()]
 
     def _requeue(self, pod: PodRequest, now: float, reason: str) -> None:
         self._pending[pod.key] = pod

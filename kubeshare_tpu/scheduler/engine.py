@@ -658,6 +658,77 @@ class SchedulerEngine:
                         ordinals[pod.key], free[0])
         return free[0]
 
+    def find_preemption(self, pod: PodRequest,
+                        nodes: list[str] | None = None) -> dict | None:
+        """Victim search for a blocked GUARANTEE pod: the fewest
+        opportunistic bookings on one node whose removal lets *pod* pass
+        filtering. Returns ``{"node", "victims": [pod keys]}`` or None.
+
+        Pure simulation — victims' bookings are temporarily reclaimed,
+        filtering re-run, and everything restored EXACTLY before
+        returning; actually evicting is the control plane's job (the
+        dispatcher requests it, the bridge deletes the pods, the normal
+        DELETED event reclaims for real).
+
+        Extends the reference's priority semantics (opportunistic pods
+        are explicitly the displaceable filler, ``constants.go:13-15``,
+        ``README.md:41-43``) with the displacement itself — the
+        reference never evicts, so a late guarantee pod starves behind
+        opportunistic ones until they exit on their own.
+        """
+        if not pod.needs_tpu or pod.opportunistic:
+            return None
+        best: dict | None = None
+        for node in (nodes if nodes is not None else list(self.nodes)):
+            fit, _ = self.filter(pod, node)
+            if fit:
+                # the block is NOT capacity on this node (a reserve-time
+                # refusal, e.g. gang rank exhaustion) — evictions here
+                # would kill filler without ever unblocking the pod
+                continue
+            candidates = [
+                p for p in self.pod_status.values()
+                if p.node_name == node and p.opportunistic and p.bookings
+                and not (pod.group_name and p.group_key == pod.group_key)
+            ]
+            # cheapest eviction first: lowest priority, then newest
+            # (least sunk work)
+            candidates.sort(key=lambda p: (p.priority, -p.timestamp))
+            reclaimed: list[PodRequest] = []
+            plan: dict | None = None
+            try:
+                for victim in candidates:
+                    for chip_id, compute, memory in victim.bookings:
+                        cell = self.leaf_cells.get(chip_id)
+                        if cell is not None:
+                            reclaim_resource(cell, compute, memory)
+                    reclaimed.append(victim)
+                    fit, _ = self.filter(pod, node)
+                    if fit:
+                        # evicting part of a gang strands the rest —
+                        # the eviction list pulls in whole groups
+                        keys: list[str] = []
+                        for v in reclaimed:
+                            if v.group_name:
+                                keys.extend(m.key for m in
+                                            self._group_members(v)
+                                            if m.key not in keys)
+                            elif v.key not in keys:
+                                keys.append(v.key)
+                        plan = {"node": node, "victims": keys}
+                        break
+            finally:
+                for victim in reclaimed:
+                    for chip_id, compute, memory in victim.bookings:
+                        cell = self.leaf_cells.get(chip_id)
+                        if cell is not None:
+                            reserve_resource(cell, compute, memory)
+            if plan is not None and (best is None or
+                                     len(plan["victims"])
+                                     < len(best["victims"])):
+                best = plan
+        return best
+
     def unreserve(self, pod: PodRequest) -> list[str]:
         """Roll back a reservation; returns group members that should be
         rejected with it (Unreserve, scheduler.go:534-549)."""

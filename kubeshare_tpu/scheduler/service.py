@@ -169,6 +169,9 @@ class SchedulerService:
                     return
                 if self.path == "/state":
                     return self._reply(200, svc.state())
+                if self.path == "/evictions":
+                    return self._reply(
+                        200, {"evictions": svc.dispatcher.evictions()})
                 parts = self.path.strip("/").split("/")
                 if len(parts) == 3 and parts[0] == "pods":
                     return self._reply(

@@ -44,6 +44,7 @@ class FakeKubeAPI:
         self.events: list[tuple[str, dict]] = []  # queued watch events
         self.patches: list[tuple[str, dict]] = []
         self.binds: list[tuple[str, str]] = []
+        self.deletes: list[str] = []           # pod DELETE calls (eviction)
         self.order: list[str] = []             # interleaving of writes
         #: when set, the next watch stream first delivers this in-band
         #: ERROR Status (e.g. 410 Gone for an expired resourceVersion —
@@ -102,6 +103,27 @@ class FakeKubeAPI:
                 api.patches.append((key, ann))
                 api.order.append(f"patch:{key}")
                 self._reply(200, api.pods[key])
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")  # api v1 ns X pods Y
+                key = f"{parts[3]}/{parts[5]}"
+                api.deletes.append(key)
+                api.order.append(f"delete:{key}")
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}") \
+                    if length else {}
+                pod = api.pods.get(key)
+                if pod is None:
+                    return self._reply(404, {"kind": "Status", "code": 404})
+                want = (body.get("preconditions") or {}).get("uid", "")
+                if want and pod["metadata"].get("uid") != want:
+                    # apiserver precondition conflict: wrong incarnation
+                    return self._reply(409, {"kind": "Status", "code": 409,
+                                             "reason": "Conflict"})
+                del api.pods[key]
+                # a real apiserver emits the DELETED watch event
+                api.events.append(("DELETED", pod))
+                self._reply(200, {"kind": "Status", "status": "Success"})
 
             def do_POST(self):
                 parts = self.path.strip("/").split("/")
@@ -440,6 +462,55 @@ def test_bind_conflict_is_retried_on_next_sync():
         bridge.sync_once()            # retry: conflict cleared
         assert api.binds and api.binds[0][0] == key
         assert api.pods[key]["spec"]["nodeName"]
+    finally:
+        svc.close()
+        api.close()
+
+
+def test_bridge_executes_preemption_end_to_end():
+    """A guarantee pod displaces an opportunistic one through the REAL
+    control loop: blocked schedule -> /evictions -> API delete ->
+    DELETED event releases the booking -> dispatcher rebinds the
+    preemptor -> bridge writes the bind back."""
+    import time as _time
+
+    api = FakeKubeAPI()
+    eng = SchedulerEngine()
+    reg = TelemetryRegistry()
+    for chip in FakeTopology(hosts=1, mesh=(1,)).chips():
+        reg.put_capacity(chip.host, [chip.to_labels()])
+    svc = SchedulerService(eng, reg, replay=False, retry_backoff_s=0.05)
+    svc.serve()
+    bridge = make_bridge(api, svc)
+    try:
+        opp = api.add_pod(make_pod("opp", labels={
+            C.POD_TPU_REQUEST: "1", C.POD_TPU_LIMIT: "1"}))
+        bridge.sync_once()
+        assert api.binds and api.binds[0][0] == opp
+
+        guar = api.add_pod(make_pod("guar", labels={
+            C.POD_TPU_REQUEST: "1", C.POD_TPU_LIMIT: "1",
+            C.POD_PRIORITY: "50"}))
+        bridge.sync_once()
+        assert not any(k == guar for k, _ in api.binds)
+
+        # the poll loop executes the plan; call its body directly
+        bridge.execute_evictions()
+        assert opp in api.deletes
+        # deliver the API's DELETED watch event (the run loop would)
+        events, api.events = api.events, []
+        for etype, obj in events:
+            bridge.handle(etype, obj)
+
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            bridge.poll_pending()
+            if any(k == guar for k, _ in api.binds):
+                break
+            _time.sleep(0.05)
+        assert any(k == guar for k, _ in api.binds), \
+            "preemptor never bound after the victim's release"
+        assert svc.dispatcher.evictions() == []
     finally:
         svc.close()
         api.close()

@@ -317,3 +317,99 @@ def test_node_health_flip_steers_and_recovers(clock):
     out = disp.outcome(c)
     assert out is not None and out.status == "bound"
     assert out.binding.node == first_node
+
+
+# --------------------------------------------------------------------------
+# preemption: a blocked guarantee pod requests eviction of opportunistic
+# filler; the victims' normal DELETED path completes the displacement
+# --------------------------------------------------------------------------
+
+def test_guarantee_pod_preempts_opportunistic_filler(clock):
+    eng = make_engine(mesh=(2,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    for i in range(2):
+        d.submit("ns", f"opp{i}", shared("1", "1"))
+    d.step()
+    assert all(d.status(f"ns/opp{i}")["status"] == "bound"
+               for i in range(2))
+
+    d.submit("ns", "guar", shared("1", "1", **{C.POD_PRIORITY: "50"}))
+    d.step()
+    # blocked -> eviction requested, preemptor queued with the reason
+    ev = d.evictions()
+    assert len(ev) == 1 and ev[0]["preemptor"] == "ns/guar"
+    assert "preempting" in d.status("ns/guar")["reason"]
+
+    # the control plane deletes the victim (normal DELETED event path)
+    d.delete(ev[0]["victim"])
+    clock.t += 10.0
+    d.step()
+    assert d.evictions() == []          # request observed complete
+    assert d.status("ns/guar")["status"] == "bound"
+
+
+def test_eviction_cancelled_when_preemptor_binds_elsewhere(clock):
+    """Capacity freeing on another chip must CANCEL the outstanding
+    eviction — a stale request would kill filler for a satisfied pod."""
+    eng = make_engine(mesh=(2,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    for i in range(2):
+        d.submit("ns", f"opp{i}", shared("1", "1"))
+    d.step()
+    d.submit("ns", "guar", shared("1", "1", **{C.POD_PRIORITY: "50"}))
+    d.step()
+    ev = d.evictions()
+    assert len(ev) == 1
+    other = next(f"ns/opp{i}" for i in range(2)
+                 if f"ns/opp{i}" != ev[0]["victim"])
+    d.delete(other)                     # owner removed the OTHER filler
+    clock.t += 10.0
+    d.step()
+    assert d.status("ns/guar")["status"] == "bound"
+    assert d.evictions() == [], "request must be cancelled, not executed"
+    assert ev[0]["victim"] in eng.pod_status  # victim survived
+
+
+def test_eviction_cancelled_when_preemptor_deleted(clock):
+    eng = make_engine(mesh=(1,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    d.submit("ns", "opp", shared("1", "1"))
+    d.step()
+    d.submit("ns", "guar", shared("1", "1", **{C.POD_PRIORITY: "50"}))
+    d.step()
+    assert d.evictions()
+    d.delete("ns/guar")                 # owner gave up on the preemptor
+    clock.t += 10.0
+    d.step()
+    assert d.evictions() == []
+    assert "ns/opp" in eng.pod_status
+
+
+def test_eviction_completes_on_uid_change(clock):
+    """A controller recreating the victim under the same name (new uid)
+    completes the request — the new incarnation is innocent."""
+    eng = make_engine(mesh=(1,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    d.submit("ns", "opp", shared("1", "1"), uid="uid-1")
+    d.step()
+    d.submit("ns", "guar", shared("1", "1", **{C.POD_PRIORITY: "50"}))
+    d.step()
+    assert d.evictions() and d.evictions()[0]["uid"] == "uid-1"
+    # recreate under the same key with a fresh uid (resubmit path)
+    d.delete("ns/opp")
+    d.submit("ns", "opp", shared("1", "1"), uid="uid-2")
+    clock.t += 10.0
+    d.step()
+    assert all(e["uid"] != "uid-1" for e in d.evictions())
+
+
+def test_opportunistic_pod_does_not_preempt(clock):
+    eng = make_engine(mesh=(2,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    for i in range(2):
+        d.submit("ns", f"opp{i}", shared("1", "1"))
+    d.step()
+    d.submit("ns", "late", shared("1", "1"))
+    d.step()
+    assert d.evictions() == []
+    assert d.status("ns/late")["status"] == "pending"

@@ -522,3 +522,78 @@ def test_port_pool_round_robin_reuse():
     eng.delete_pod("ns/a")
     b2 = eng.schedule(eng.submit("ns", "b", shared_labels("0.3", "1.0")))
     assert b2.port == b1.port + 1  # round-robin, not immediate reuse
+
+
+# --------------------------------------------------------------------------
+# preemption — TPU-build extension completing the reference's priority
+# semantics (opportunistic = displaceable filler, constants.go:13-15,
+# README.md:41-43; the reference never actually displaces)
+# --------------------------------------------------------------------------
+
+def guarantee_labels(request="1", limit="1"):
+    return shared_labels(request, limit, **{C.POD_PRIORITY: "50"})
+
+
+def leaf_snapshot(eng):
+    return {cid: (l.available, l.free_memory)
+            for cid, l in eng.leaf_cells.items()}
+
+
+def test_preemption_minimal_victims_and_exact_restore():
+    eng = engine_with(hosts=1, mesh=(2,))
+    for i in range(2):
+        eng.schedule(eng.submit("ns", f"opp{i}", shared_labels("1", "1")))
+    before = leaf_snapshot(eng)
+    guar = eng.submit("ns", "guar", guarantee_labels())
+    with pytest.raises(Unschedulable):
+        eng.schedule(guar)
+    plan = eng.find_preemption(guar)
+    assert plan is not None and len(plan["victims"]) == 1
+    assert leaf_snapshot(eng) == before, "simulation must restore exactly"
+    eng.delete_pod(plan["victims"][0])
+    assert eng.schedule(guar).node
+
+
+def test_preemption_grows_victim_set_until_fit():
+    eng = engine_with(hosts=1, mesh=(1,))
+    eng.schedule(eng.submit("ns", "a", shared_labels("0.5", "1.0")))
+    eng.schedule(eng.submit("ns", "b", shared_labels("0.5", "1.0")))
+    guar = eng.submit("ns", "guar", guarantee_labels())
+    plan = eng.find_preemption(guar)
+    assert plan is not None
+    assert set(plan["victims"]) == {"ns/a", "ns/b"}
+
+
+def test_preemption_none_for_opportunistic_preemptor():
+    eng = engine_with(hosts=1, mesh=(2,))
+    for i in range(2):
+        eng.schedule(eng.submit("ns", f"opp{i}", shared_labels("1", "1")))
+    another = eng.submit("ns", "another", shared_labels("1", "1"))
+    assert eng.find_preemption(another) is None
+
+
+def test_preemption_never_evicts_guarantee_pods():
+    eng = engine_with(hosts=1, mesh=(2,))
+    for i in range(2):
+        eng.schedule(eng.submit("ns", f"g{i}",
+                                shared_labels("1", "1",
+                                              **{C.POD_PRIORITY: "10"})))
+    before = leaf_snapshot(eng)
+    guar = eng.submit("ns", "guar", guarantee_labels())
+    assert eng.find_preemption(guar) is None
+    assert leaf_snapshot(eng) == before
+
+
+def test_preemption_pulls_whole_opportunistic_gang():
+    eng = engine_with(hosts=1, mesh=(2,))
+    gang = {C.POD_GROUP_NAME: "g", C.POD_GROUP_HEADCOUNT: "2",
+            C.POD_GROUP_THRESHOLD: "1.0"}
+    members = [eng.submit("ns", f"m{i}", shared_labels("1", "1", **gang))
+               for i in range(2)]
+    for m in members:
+        eng.schedule(m)
+    guar = eng.submit("ns", "guar", guarantee_labels())
+    plan = eng.find_preemption(guar)
+    assert plan is not None
+    assert set(plan["victims"]) == {"ns/m0", "ns/m1"}, \
+        "evicting part of a gang would strand the rest"
