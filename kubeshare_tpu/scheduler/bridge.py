@@ -256,9 +256,11 @@ class PodEventBridge:
         # later with no pod event to wake us, so a poller watches their
         # status and performs the deferred write-back
         self._awaiting: dict[str, tuple[str, str, str]] = {}
-        # victims already deleted on the API this incarnation (dedupe:
-        # the scheduler keeps requesting until it OBSERVES the deletion)
-        self._evicted: set[str] = set()
+        # (victim key, uid) pairs already deleted on the API this
+        # incarnation (dedupe: the scheduler keeps requesting until it
+        # OBSERVES the deletion). uid-qualified so a victim recreated
+        # under the same name is evictable again if re-requested.
+        self._evicted: set[tuple[str, str]] = set()
 
     # -- event handling ------------------------------------------------------
 
@@ -325,8 +327,15 @@ class PodEventBridge:
         opportunistic filler). The victim's DELETED watch event then
         releases its booking through the normal path, and the preemptor
         binds on a later dispatcher cycle. Deletes are deduped per
-        incarnation; the request list itself converges server-side once
-        the victim is observed gone."""
+        incarnation by (victim, uid) — a recreated same-name victim is
+        a new target; the request list itself converges server-side
+        once the victim is observed gone.
+
+        Known race (accepted; kube-scheduler preemption carries the
+        same): a request CANCELLED after this fetch but before the
+        delete lands still kills its victim. The window is one poll
+        period, and victims are opportunistic filler — restartable by
+        contract (priority <= 0)."""
         try:
             requests = self.service.evictions()
         except Exception as e:
@@ -334,7 +343,8 @@ class PodEventBridge:
             return
         for req in requests:
             key = req.get("victim", "")
-            if not key or key in self._evicted:
+            ident = (key, req.get("uid", ""))
+            if not key or ident in self._evicted:
                 continue
             ns, _, name = key.partition("/")
             try:
@@ -343,11 +353,11 @@ class PodEventBridge:
                 log.warning("eviction of %s failed (will retry): %s",
                             key, e)
                 continue
-            self._evicted.add(key)
+            self._evicted.add(ident)
             log.info("evicted %s (preempted by %s)",
                      key, req.get("preemptor", "?"))
         # dedupe entries expire once the scheduler stops requesting them
-        live = {r.get("victim") for r in requests}
+        live = {(r.get("victim"), r.get("uid", "")) for r in requests}
         self._evicted &= live
 
     def poll_pending(self) -> None:

@@ -312,13 +312,18 @@ class Dispatcher:
             if (req.get("preemptor") == pod.key
                     and key not in plan["victims"]):
                 del self._evict_requested[key]
-        fresh = [k for k in plan["victims"]
-                 if k not in self._evict_requested]
-        for key in fresh:
+        fresh = []
+        for key in plan["victims"]:
             victim = self.engine.pod_status.get(key)
+            uid = victim.uid if victim is not None else ""
+            req = self._evict_requested.get(key)
+            if req is not None:
+                req["uid"] = uid      # victim may have been recreated —
+                continue              # keep the request live, new target
+            fresh.append(key)
             self._evict_requested[key] = {
                 "victim": key, "preemptor": pod.key, "node": plan["node"],
-                "uid": victim.uid if victim is not None else ""}
+                "uid": uid}
         if fresh:
             log.info("%s preempts %d opportunistic pod(s) on %s: %s",
                      pod.key, len(fresh), plan["node"], ", ".join(fresh))

@@ -691,9 +691,16 @@ class SchedulerEngine:
                 if p.node_name == node and p.opportunistic and p.bookings
                 and not (pod.group_name and p.group_key == pod.group_key)
             ]
-            # cheapest eviction first: lowest priority, then newest
-            # (least sunk work)
-            candidates.sort(key=lambda p: (p.priority, -p.timestamp))
+            # Cheapest eviction first: lowest priority, then SMALLEST
+            # blast radius (a gang member drags its whole gang with it —
+            # preferring standalone pods keeps the victim count at what
+            # the fit actually needs), then newest (least sunk work).
+            def eviction_cost(p):
+                gang_size = (len(self._group_members(p)) if p.group_name
+                             else 1)
+                return (p.priority, gang_size, -p.timestamp)
+
+            candidates.sort(key=eviction_cost)
             reclaimed: list[PodRequest] = []
             plan: dict | None = None
             try:

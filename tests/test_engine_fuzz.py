@@ -182,3 +182,42 @@ def test_engine_survives_random_churn(seed):
         # bit 0 (the port base) is reserved at init and never handed out
         assert ports.count() == 1, f"{node} leaked manager ports"
     assert not eng.pod_status
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_find_preemption_is_side_effect_free_under_churn(seed):
+    """find_preemption simulates by reclaim-then-restore; under random
+    fleet states the restore must be EXACT (bit-identical leaf
+    bookkeeping) whether or not a plan exists, and any returned plan
+    must actually unblock the preemptor once its victims are deleted."""
+    rng = random.Random(4200 + seed)
+    eng = make_engine()
+    live = []
+    for i in range(40):
+        pod = eng.submit("ns", f"w{i}", random_labels(rng, i))
+        try:
+            eng.schedule(pod)
+            live.append(pod.key)
+        except Unschedulable:
+            eng.delete_pod(pod.key)
+    guar = eng.submit("ns", "guar", {
+        C.POD_TPU_REQUEST: "1", C.POD_TPU_LIMIT: "1",
+        C.POD_PRIORITY: "90"})
+
+    before = {cid: (l.available, l.free_memory)
+              for cid, l in eng.leaf_cells.items()}
+    plan = eng.find_preemption(guar)
+    after = {cid: (l.available, l.free_memory)
+             for cid, l in eng.leaf_cells.items()}
+    assert after == before, "simulation leaked into the cell tree"
+    check_invariants(eng)
+
+    try:
+        eng.schedule(guar)
+        schedulable_already = True
+    except Unschedulable:
+        schedulable_already = False
+    if plan is not None and not schedulable_already:
+        for key in plan["victims"]:
+            eng.delete_pod(key)
+        eng.schedule(guar)   # must not raise: the plan's promise

@@ -597,3 +597,20 @@ def test_preemption_pulls_whole_opportunistic_gang():
     assert plan is not None
     assert set(plan["victims"]) == {"ns/m0", "ns/m1"}, \
         "evicting part of a gang would strand the rest"
+
+
+def test_preemption_prefers_standalone_over_newer_gang():
+    """A newer gang member would drag its whole gang out; the plan must
+    pick the older STANDALONE filler when one victim suffices."""
+    eng = engine_with(hosts=1, mesh=(3,))
+    eng.schedule(eng.submit("ns", "solo", shared_labels("1", "1")))
+    gang = {C.POD_GROUP_NAME: "g", C.POD_GROUP_HEADCOUNT: "2",
+            C.POD_GROUP_THRESHOLD: "1.0"}
+    members = [eng.submit("ns", f"m{i}", shared_labels("1", "1", **gang))
+               for i in range(2)]
+    for m in members:
+        eng.schedule(m)
+    guar = eng.submit("ns", "guar", guarantee_labels())
+    plan = eng.find_preemption(guar)
+    assert plan is not None
+    assert plan["victims"] == ["ns/solo"], plan
