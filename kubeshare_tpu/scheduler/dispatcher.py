@@ -233,6 +233,14 @@ class Dispatcher:
             victim = self.engine.pod_status.get(key)
             if victim is None or victim.uid != req.get("uid", victim.uid):
                 del self._evict_requested[key]
+                # fast-track the preemptor onto the freed capacity: its
+                # retry backoff must not leave a window where a fresh
+                # opportunistic arrival beats it to the chip (queue_less
+                # already ranks the guarantee pod first once READY)
+                pre = req.get("preemptor", "")
+                if pre in self._pending:
+                    self._retry_at[pre] = now
+                    self._cond.notify_all()
                 continue
             pre = self.engine.pod_status.get(req.get("preemptor", ""))
             if pre is None or pre.node_name:
@@ -305,6 +313,14 @@ class Dispatcher:
         later cycle. Returns True when a plan was adopted."""
         plan = self.engine.find_preemption(pod)
         if plan is None:
+            # a previous plan may have evaporated (capacity shifted so
+            # even full eviction no longer helps) — its outstanding
+            # requests would kill filler without unblocking anyone
+            for key, req in list(self._evict_requested.items()):
+                if req.get("preemptor") == pod.key:
+                    log.info("eviction of %s cancelled (plan for %s "
+                             "evaporated)", key, pod.key)
+                    del self._evict_requested[key]
             return False
         # this preemptor's previous plan may have shifted (capacity moved
         # between retries) — keep only the victims the CURRENT plan needs

@@ -680,11 +680,16 @@ class SchedulerEngine:
             return None
         best: dict | None = None
         for node in (nodes if nodes is not None else list(self.nodes)):
-            fit, _ = self.filter(pod, node)
+            fit, why = self.filter(pod, node)
             if fit:
                 # the block is NOT capacity on this node (a reserve-time
                 # refusal, e.g. gang rank exhaustion) — evictions here
                 # would kill filler without ever unblocking the pod
+                continue
+            if "cannot fit" not in why:
+                # non-capacity failure (model mismatch, port pool, gang
+                # sub-mesh): no amount of eviction produces a fit — skip
+                # the whole simulation on this node
                 continue
             candidates = [
                 p for p in self.pod_status.values()
@@ -712,16 +717,39 @@ class SchedulerEngine:
                     reclaimed.append(victim)
                     fit, _ = self.filter(pod, node)
                     if fit:
+                        # Drop greedily-taken victims that contributed
+                        # nothing: re-reserve each (newest-first) and
+                        # keep it OUT of the plan if the pod still fits
+                        # without its chips (the fit may have come from
+                        # a later, unrelated chip).
+                        needed = []
+                        for v in reversed(reclaimed):
+                            for chip_id, compute, memory in v.bookings:
+                                cell = self.leaf_cells.get(chip_id)
+                                if cell is not None:
+                                    reserve_resource(cell, compute,
+                                                     memory)
+                            still_fit, _ = self.filter(pod, node)
+                            if still_fit:
+                                continue          # v was unnecessary
+                            for chip_id, compute, memory in v.bookings:
+                                cell = self.leaf_cells.get(chip_id)
+                                if cell is not None:
+                                    reclaim_resource(cell, compute,
+                                                     memory)
+                            needed.append(v)
                         # evicting part of a gang strands the rest —
                         # the eviction list pulls in whole groups
                         keys: list[str] = []
-                        for v in reclaimed:
+                        for v in needed:
                             if v.group_name:
                                 keys.extend(m.key for m in
                                             self._group_members(v)
                                             if m.key not in keys)
                             elif v.key not in keys:
                                 keys.append(v.key)
+                        # restore state for the victims we kept reclaimed
+                        reclaimed = needed
                         plan = {"node": node, "victims": keys}
                         break
             finally:

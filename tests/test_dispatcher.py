@@ -413,3 +413,41 @@ def test_opportunistic_pod_does_not_preempt(clock):
     d.step()
     assert d.evictions() == []
     assert d.status("ns/late")["status"] == "pending"
+
+
+def test_eviction_cancelled_when_plan_evaporates(clock):
+    """Capacity shifting so that no eviction can help must cancel the
+    outstanding requests — filler must not die for an unschedulable
+    preemptor."""
+    eng = make_engine(mesh=(2,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    d.submit("ns", "opp", shared("1", "1"))
+    d.step()
+    d.submit("ns", "guar2", shared("2", "2", **{C.POD_PRIORITY: "50"}))
+    d.step()
+    assert d.evictions(), "2-chip pod blocked by 1-chip filler: plan"
+    # another guarantee pod takes the free chip: now even full eviction
+    # leaves only 1 chip — the plan evaporates
+    d.submit("ns", "other", shared("1", "1", **{C.POD_PRIORITY: "60"}))
+    clock.t += 10.0
+    d.step()
+    assert d.status("ns/other")["status"] == "bound"
+    assert d.evictions() == []
+    assert "ns/opp" in eng.pod_status
+
+
+def test_preemptor_fast_tracked_past_backoff(clock):
+    """Victim completion clears the preemptor's retry backoff so a
+    fresh opportunistic arrival cannot beat it to the freed chip."""
+    eng = make_engine(mesh=(1,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    d.submit("ns", "opp", shared("1", "1"))
+    d.step()
+    d.submit("ns", "guar", shared("1", "1", **{C.POD_PRIORITY: "50"}))
+    d.step()
+    ev = d.evictions()
+    assert ev
+    d.delete(ev[0]["victim"])
+    d.step()   # sweep observes completion, clears the backoff
+    d.step()   # NO clock advance: preemptor must already be ready
+    assert d.status("ns/guar")["status"] == "bound"

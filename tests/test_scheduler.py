@@ -614,3 +614,34 @@ def test_preemption_prefers_standalone_over_newer_gang():
     plan = eng.find_preemption(guar)
     assert plan is not None
     assert plan["victims"] == ["ns/solo"], plan
+
+
+def test_preemption_drops_useless_greedy_victims():
+    """A newer victim reclaimed before the one that actually produced
+    the fit must be dropped from the plan (re-reserve sweep): only the
+    contributing victim dies."""
+    eng = engine_with(hosts=1, mesh=(2,))
+    gb = eng.schedule(eng.submit("ns", "g0", shared_labels(
+        "0.5", "1.0", **{C.POD_PRIORITY: "10"})))
+    # older whole-chip filler on the OTHER chip
+    opp2 = eng.submit("ns", "opp2", shared_labels("1", "1"))
+    b2 = eng.schedule(opp2)
+    assert b2.chip_ids != gb.chip_ids
+    # newer fractional filler co-located with the guarantee pod
+    eng.schedule(eng.submit("ns", "opp1", shared_labels("0.5", "1.0")))
+
+    guar = eng.submit("ns", "guar", guarantee_labels())
+    plan = eng.find_preemption(guar)
+    assert plan is not None
+    assert plan["victims"] == ["ns/opp2"], \
+        f"opp1 contributes nothing to a whole-chip fit: {plan}"
+
+
+def test_preemption_skips_non_capacity_nodes():
+    """Model-mismatched nodes must not be simulated at all — eviction
+    can never produce a fit there."""
+    eng = engine_with(hosts=1, mesh=(1,), model="TPU-v4")
+    eng.schedule(eng.submit("ns", "opp", shared_labels("1", "1")))
+    guar = eng.submit("ns", "guar", shared_labels(
+        "1", "1", **{C.POD_PRIORITY: "50", C.POD_TPU_MODEL: "TPU-v5e"}))
+    assert eng.find_preemption(guar) is None
