@@ -77,6 +77,7 @@ class SimStats:
     placed: int = 0
     failed: int = 0
     retries: int = 0
+    preemptions: int = 0
     total_wait_s: float = 0.0
     chip_seconds: float = 0.0
     makespan_s: float = 0.0
@@ -90,6 +91,7 @@ class SimStats:
         return {
             "submitted": self.submitted, "placed": self.placed,
             "failed": self.failed, "retries": self.retries,
+            "preemptions": self.preemptions,
             "mean_wait_s": round(self.mean_wait_s, 3),
             "chip_seconds": round(self.chip_seconds, 1),
             "makespan_s": round(self.makespan_s, 1),
@@ -108,11 +110,28 @@ class Simulator:
     """
 
     def __init__(self, engine: SchedulerEngine, seed: int = 0,
-                 namespace: str = "sim"):
+                 namespace: str = "sim", preempt: bool = False,
+                 label_fn=None):
         self.engine = engine
         self.rng = random.Random(seed)
         self.namespace = namespace
+        #: model the dispatcher's preemption: a blocked guarantee job
+        #: displaces opportunistic filler (fewest-victim plan); victims
+        #: restart from scratch via the pending queue
+        self.preempt = preempt
+        #: labels per job — defaults to the reference synthesis rule;
+        #: override to mix in guarantee priorities for preemption runs
+        self.label_fn = label_fn or synthesize_labels
         self.stats = SimStats()
+        #: key -> (name, job, submitted_at, placed_at, request)
+        self._live: dict[str, tuple] = {}
+        #: key -> count of void completion events still in the heap
+        #: (a job can be preempted again while a stale event is queued)
+        self._evicted: dict[str, int] = {}
+        #: name -> labels, cached so a restarted victim is the SAME
+        #: workload and the rng stream stays aligned between
+        #: preempt/no-preempt runs of one seed
+        self._labels: dict[str, dict] = {}
 
     def run(self, jobs: list[TraceJob]) -> SimStats:
         submit_time = 0.0
@@ -129,19 +148,42 @@ class Simulator:
             nonlocal seq
             pod = self.engine.pod_status.get(f"{self.namespace}/{name}")
             if pod is None:
-                labels = synthesize_labels(job, self.rng)
-                pod = self.engine.submit(self.namespace, name, labels)
+                if name not in self._labels:
+                    self._labels[name] = self.label_fn(job, self.rng)
+                pod = self.engine.submit(self.namespace, name,
+                                         self._labels[name])
             try:
                 binding = self.engine.schedule(pod)
             except Unschedulable:
-                return False
+                if not (self.preempt and not pod.opportunistic):
+                    return False
+                plan = self.engine.find_preemption(pod)
+                if plan is None:
+                    return False
+                for vkey in plan["victims"]:
+                    entry = self._live.pop(vkey, None)
+                    self.engine.delete_pod(vkey)
+                    self._evicted[vkey] = self._evicted.get(vkey, 0) + 1
+                    self.stats.preemptions += 1
+                    if entry is not None:
+                        vname, vjob, _, placed_at, vreq = entry
+                        # the cut-short run delivered only its executed
+                        # slice; the restart's queue wait starts NOW
+                        self.stats.chip_seconds += vreq * (now - placed_at)
+                        pending.append((vname, vjob, now))
+                try:
+                    binding = self.engine.schedule(pod)
+                except Unschedulable:
+                    return False
             self.stats.placed += 1
             self.stats.total_wait_s += now - submitted_at
-            self.stats.chip_seconds += pod.request * job.runtime_s
             self.stats.per_node[binding.node] = (
                 self.stats.per_node.get(binding.node, 0) + 1)
+            self._live[pod.key] = (name, job, submitted_at, now,
+                                   pod.request)
             heapq.heappush(events, (now + job.runtime_s, seq, "complete",
                                     pod.key))
+            seq += 1
             return True
 
         while events:
@@ -153,6 +195,19 @@ class Simulator:
                 if not try_place(name, job, now):
                     pending.append((name, job, now))
             else:
+                if self._evicted.get(payload):
+                    # the victim was preempted: its old completion event
+                    # is void (the restarted run scheduled a new one)
+                    self._evicted[payload] -= 1
+                    if not self._evicted[payload]:
+                        del self._evicted[payload]
+                    continue
+                entry = self._live.pop(payload, None)
+                if entry is not None:
+                    # chip-seconds are credited on actual execution:
+                    # full runtime here, the executed slice at eviction
+                    _, cjob, _, _, creq = entry
+                    self.stats.chip_seconds += creq * cjob.runtime_s
                 self.engine.delete_pod(payload)
                 still_pending = []
                 for name, job, submitted_at in pending:
@@ -185,6 +240,15 @@ def main(argv=None) -> None:
     parser.add_argument("--topology", default="2:2x2@TPU-v4",
                         help="fake fleet spec <hosts>:<mesh>[@model]")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--preempt", action="store_true",
+                        help="model dispatcher preemption: blocked "
+                             "guarantee jobs displace opportunistic "
+                             "filler; victims restart from scratch")
+    parser.add_argument("--guarantee-frac", type=float, default=0.0,
+                        help="fraction of jobs upgraded to guarantee "
+                             "priority 50 (the canonical synthesis is "
+                             "all-opportunistic; >0 makes --preempt "
+                             "meaningful)")
     args = parser.parse_args(argv)
 
     if bool(args.synthetic) == bool(args.trace):
@@ -201,7 +265,15 @@ def main(argv=None) -> None:
         chips_by_host.setdefault(chip.host, []).append(chip)
     for host, chips in chips_by_host.items():
         engine.add_node(host, chips)
-    stats = Simulator(engine, seed=args.seed).run(jobs)
+    label_fn = None
+    if args.guarantee_frac > 0:
+        def label_fn(job, rng, _f=args.guarantee_frac):
+            labels = synthesize_labels(job, rng)
+            if rng.random() < _f:
+                labels[C.POD_PRIORITY] = "50"
+            return labels
+    stats = Simulator(engine, seed=args.seed, preempt=args.preempt,
+                      label_fn=label_fn).run(jobs)
     print(json.dumps(stats.to_json()))
 
 
