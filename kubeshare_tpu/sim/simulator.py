@@ -74,10 +74,11 @@ def synthesize_labels(job: TraceJob, rng: random.Random) -> dict:
 @dataclass
 class SimStats:
     submitted: int = 0
-    placed: int = 0
+    placed: int = 0          # jobs first-placed: submitted == placed+failed
     failed: int = 0
     retries: int = 0
     preemptions: int = 0
+    restarts: int = 0        # re-placements of preempted victims
     total_wait_s: float = 0.0
     chip_seconds: float = 0.0
     makespan_s: float = 0.0
@@ -91,7 +92,7 @@ class SimStats:
         return {
             "submitted": self.submitted, "placed": self.placed,
             "failed": self.failed, "retries": self.retries,
-            "preemptions": self.preemptions,
+            "preemptions": self.preemptions, "restarts": self.restarts,
             "mean_wait_s": round(self.mean_wait_s, 3),
             "chip_seconds": round(self.chip_seconds, 1),
             "makespan_s": round(self.makespan_s, 1),
@@ -132,6 +133,7 @@ class Simulator:
         #: workload and the rng stream stays aligned between
         #: preempt/no-preempt runs of one seed
         self._labels: dict[str, dict] = {}
+        self._placed_once: set[str] = set()
 
     def run(self, jobs: list[TraceJob]) -> SimStats:
         submit_time = 0.0
@@ -175,8 +177,16 @@ class Simulator:
                     binding = self.engine.schedule(pod)
                 except Unschedulable:
                     return False
-            self.stats.placed += 1
-            self.stats.total_wait_s += now - submitted_at
+            if name in self._placed_once:
+                # a preempted victim's re-placement: the job was already
+                # counted placed and its first-bind wait recorded — the
+                # restart's cost shows up as preemptions/lost
+                # chip-seconds, not as placement or wait inflation
+                self.stats.restarts += 1
+            else:
+                self._placed_once.add(name)
+                self.stats.placed += 1
+                self.stats.total_wait_s += now - submitted_at
             self.stats.per_node[binding.node] = (
                 self.stats.per_node.get(binding.node, 0) + 1)
             self._live[pod.key] = (name, job, submitted_at, now,

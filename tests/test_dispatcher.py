@@ -451,3 +451,32 @@ def test_preemptor_fast_tracked_past_backoff(clock):
     d.step()   # sweep observes completion, clears the backoff
     d.step()   # NO clock advance: preemptor must already be ready
     assert d.status("ns/guar")["status"] == "bound"
+
+
+def test_guarantee_gang_preempts_its_way_in(clock):
+    """A 2-member guarantee gang blocked by opportunistic filler: each
+    member's plan evicts one filler pod; the gang permits once both
+    bind — preemption and the permit barrier compose."""
+    eng = make_engine(mesh=(2,), clock=clock)
+    d = Dispatcher(eng, clock=clock)
+    for i in range(2):
+        d.submit("ns", f"opp{i}", shared("1", "1"))
+    d.step()
+
+    for i in range(2):
+        d.submit("ns", f"g-{i}", gang("g", headcount=2, request="1",
+                                      limit="1", priority="50"))
+    deadline_rounds = 10
+    for _ in range(deadline_rounds):
+        d.step()
+        for ev in d.evictions():
+            d.delete(ev["victim"])      # the bridge's job, simulated
+        clock.t += 2.0
+        if all(d.status(f"ns/g-{i}")["status"] == "bound"
+               for i in range(2)):
+            break
+    assert all(d.status(f"ns/g-{i}")["status"] == "bound"
+               for i in range(2)), [d.status(f"ns/g-{i}")
+                                    for i in range(2)]
+    assert "ns/opp0" not in eng.pod_status
+    assert "ns/opp1" not in eng.pod_status

@@ -256,8 +256,11 @@ def test_sim_preemption_displaces_filler_in_virtual_time():
                     label_fn=lambda job, rng: next(order))
     stats = sim.run(jobs)
     assert stats.preemptions == 1
-    assert stats.placed == 3          # filler, guarantee, filler restart
+    assert stats.placed == 2 and stats.restarts == 1
+    assert stats.submitted == stats.placed + stats.failed
     assert stats.failed == 0
+    # first-bind waits only: filler 0, guarantee 0 (displacement)
+    assert stats.mean_wait_s == pytest.approx(0.0)
     # executed chip-seconds only: 10 (cut-short filler) + 100
     # (guarantee) + 1000 (restarted filler) — no double credit
     assert stats.chip_seconds == pytest.approx(1110.0)
