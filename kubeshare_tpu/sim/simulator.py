@@ -187,8 +187,10 @@ class Simulator:
                 self._placed_once.add(name)
                 self.stats.placed += 1
                 self.stats.total_wait_s += now - submitted_at
-            self.stats.per_node[binding.node] = (
-                self.stats.per_node.get(binding.node, 0) + 1)
+                # first binds only: sum(per_node) == placed stays an
+                # invariant (restarts are counted separately above)
+                self.stats.per_node[binding.node] = (
+                    self.stats.per_node.get(binding.node, 0) + 1)
             self._live[pod.key] = (name, job, submitted_at, now,
                                    pod.request)
             heapq.heappush(events, (now + job.runtime_s, seq, "complete",
