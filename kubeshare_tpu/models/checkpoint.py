@@ -18,6 +18,7 @@ on save.
 from __future__ import annotations
 
 import os
+import shutil
 import time
 
 import jax
@@ -90,6 +91,22 @@ def verify_shared_path(path: str | os.PathLike) -> None:
             f"shared volume (RWX) or drop --checkpoint.")
 
 
+def _state_tree(params, opt_state, step: int) -> dict:
+    """The saved pytree, shared by the sync and async save paths."""
+    if jax.process_count() > 1:
+        leaves = [x if isinstance(x, jax.Array) else np.asarray(x)
+                  for x in jax.tree_util.tree_leaves((params, opt_state))]
+        # step rides as a 0-d array (construct_restore_args has no
+        # handler for python/numpy scalars on the restore side)
+        step_leaf = np.asarray(int(step), np.int64)
+    else:
+        leaves = [np.asarray(x) if hasattr(x, "fetch") else x
+                  for x in jax.tree_util.tree_leaves(
+                      _materialize((params, opt_state)))]
+        step_leaf = int(step)
+    return {"leaves": leaves, "step": step_leaf}
+
+
 def save_checkpoint(path: str | os.PathLike, params, opt_state,
                     step: int) -> None:
     """Atomic full-state save (Orbax writes to a tmp dir and renames).
@@ -102,20 +119,80 @@ def save_checkpoint(path: str | os.PathLike, params, opt_state,
     persist only one member's shards)."""
     import orbax.checkpoint as ocp
 
-    if jax.process_count() > 1:
-        leaves = [x if isinstance(x, jax.Array) else np.asarray(x)
-                  for x in jax.tree_util.tree_leaves((params, opt_state))]
-        # step rides as a 0-d array (construct_restore_args has no
-        # handler for python/numpy scalars on the restore side)
-        step_leaf = np.asarray(int(step), np.int64)
-    else:
-        leaves = [np.asarray(x) if hasattr(x, "fetch") else x
-                  for x in jax.tree_util.tree_leaves(
-                      _materialize((params, opt_state)))]
-        step_leaf = int(step)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(os.path.abspath(os.fspath(path)),
-                   {"leaves": leaves, "step": step_leaf}, force=True)
+                   _state_tree(params, opt_state, step), force=True)
+
+
+def _staging(path: str) -> str:
+    return path + ".staging"
+
+
+class AsyncCheckpointWriter:
+    """Overlapped checkpointing: ``save()`` returns once the state is
+    snapshotted off the live buffers; serialization and the commit
+    flush on Orbax's background machinery while training continues
+    (the step stall shrinks from the full write to the snapshot).
+
+    Crash-safety: each async save lands in a STAGING sibling
+    (``<path>.staging``) and is promoted over ``<path>`` only after
+    its flush committed — the previous good checkpoint stays intact
+    through every flush, so a crash never leaves zero checkpoints
+    (:func:`load_checkpoint` also falls back to a committed staging
+    dir, closing even the promote's rename window). At most one save
+    is in flight; the on-disk state is at most one save behind.
+
+    In a GANG (``jax.process_count() > 1``) saves go through the SYNC
+    path unchanged — cross-process promote would need its own barrier
+    choreography; the overlap is a single-process optimization (the
+    reference-parity workload shape)."""
+
+    def __init__(self):
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        self._pending: str | None = None    # path awaiting promote
+
+    def _promote(self) -> None:
+        """Move the FLUSHED staging checkpoint over the main path (call
+        only after wait_until_finished). The window with no ``path`` is
+        two renames; load_checkpoint's staging fallback covers it."""
+        if self._pending is None:
+            return
+        path, self._pending = self._pending, None
+        staging = _staging(path)
+        old = path + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.exists(path):
+            os.rename(path, old)
+        os.rename(staging, path)
+        shutil.rmtree(old, ignore_errors=True)
+
+    def save(self, path: str | os.PathLike, params, opt_state,
+             step: int) -> None:
+        if jax.process_count() > 1:
+            save_checkpoint(path, params, opt_state, step)
+            return
+        self._ckptr.wait_until_finished()   # bound in-flight saves at 1
+        self._promote()
+        path = os.path.abspath(os.fspath(path))
+        self._ckptr.save(_staging(path),
+                         _state_tree(params, opt_state, step), force=True)
+        self._pending = path
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+        self._promote()
+
+    def close(self) -> None:
+        self._ckptr.close()                 # waits, then tears down
+        self._promote()
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def load_checkpoint(path: str | os.PathLike, like_params, like_opt_state):
@@ -131,7 +208,14 @@ def load_checkpoint(path: str | os.PathLike, like_params, like_opt_state):
 
     path = os.path.abspath(os.fspath(path))
     if not os.path.isdir(path):
-        raise FileNotFoundError(path)
+        # a crash in AsyncCheckpointWriter's promote window leaves the
+        # newest COMMITTED state in the staging sibling (orbax commits
+        # are atomic per directory, so a committed staging dir is a
+        # complete checkpoint; a partial flush fails restore loudly)
+        if os.path.isdir(_staging(path)):
+            path = _staging(path)
+        else:
+            raise FileNotFoundError(path)
     like_leaves = jax.tree_util.tree_leaves((like_params, like_opt_state))
     if jax.process_count() > 1:
         def abstract(x):

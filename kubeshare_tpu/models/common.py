@@ -169,9 +169,17 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
     # would otherwise dwarf the steady-state steps in the trace.
     trace_ctx = (jax.profiler.trace(profile_dir) if profile_dir
                  else contextlib.nullcontext())
+    # In-loop saves overlap IO with training (AsyncCheckpointWriter):
+    # the step stall shrinks to the state snapshot, the write flushes
+    # while the next steps run, and close() below guarantees the final
+    # state is committed before run_training returns.
+    writer_ctx = contextlib.nullcontext()
+    if checkpoint and checkpoint_every:
+        from .checkpoint import AsyncCheckpointWriter
+        writer_ctx = AsyncCheckpointWriter()
     remaining = max(0, steps - done)
     start = time.perf_counter()
-    with trace_ctx:
+    with trace_ctx, writer_ctx as writer:
         for i in range(1, remaining + 1):
             if gate is not None:
                 gate()
@@ -182,7 +190,9 @@ def run_training(init_fn: Callable, loss_fn: Callable, batch_fn: Callable,
             float(loss)
             if (checkpoint and checkpoint_every
                     and i % checkpoint_every == 0):
-                save_checkpoint(checkpoint, params, opt_state, done + i)
+                writer.save(checkpoint, params, opt_state, done + i)
+    # the with-block exit closed the writer: the last in-flight save is
+    # flushed AND promoted before elapsed is read
     elapsed = time.perf_counter() - start
     if checkpoint and remaining and not (
             checkpoint_every and remaining % checkpoint_every == 0):

@@ -158,3 +158,95 @@ def test_resnet50_class_depth():
     x, y = resnet.batch_fn(jax.random.PRNGKey(1))
     loss = resnet.loss_fn(params, (x[:4], y[:4]))
     assert np.isfinite(float(loss))
+
+
+def test_async_checkpoint_writer_matches_sync(tmp_path):
+    """AsyncCheckpointWriter commits the same restorable state as the
+    sync path; a newer save supersedes the in-flight one (bounded at
+    one behind), and close() guarantees the final commit."""
+    import numpy as np
+    import optax
+
+    from kubeshare_tpu.models import mnist
+    from kubeshare_tpu.models.checkpoint import (AsyncCheckpointWriter,
+                                                 load_checkpoint,
+                                                 save_checkpoint)
+    from kubeshare_tpu.models.common import make_train_step
+
+    key = jax.random.PRNGKey(0)
+    pkey, bkey = jax.random.split(key)
+    optimizer = optax.adam(1e-3)
+    step = make_train_step(mnist.loss_fn, optimizer)
+    batch = mnist.batch_fn(bkey)
+    p = mnist.init(pkey)
+    s = optimizer.init(p)
+
+    with AsyncCheckpointWriter() as w:
+        for i in range(1, 4):
+            p, s, _ = step(p, s, batch)
+            w.save(tmp_path / "async", p, s, step=i)  # train continues
+    save_checkpoint(tmp_path / "sync", p, s, step=3)
+
+    like_p = mnist.init(jax.random.PRNGKey(9))
+    like_s = optimizer.init(like_p)
+    pa, sa, at = load_checkpoint(tmp_path / "async", like_p, like_s)
+    ps, ss, st = load_checkpoint(tmp_path / "sync", like_p, like_s)
+    assert at == st == 3
+    for a, b in zip(jax.tree_util.tree_leaves((pa, sa)),
+                    jax.tree_util.tree_leaves((ps, ss))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_run_training_overlapped_checkpoints_resume(tmp_path):
+    """checkpoint_every now saves through the async writer inside the
+    timed loop; the committed state must still resume exactly."""
+    import optax
+
+    from kubeshare_tpu.models import mnist
+    from kubeshare_tpu.models.common import run_training
+
+    ck = tmp_path / "ck"
+    r1 = run_training(mnist.init, mnist.loss_fn, mnist.batch_fn, 4,
+                      checkpoint=str(ck), checkpoint_every=2, warmup=1)
+    assert r1.steps == 4
+    # rerun: resumes at step 4, nothing left to do, loss unchanged
+    r2 = run_training(mnist.init, mnist.loss_fn, mnist.batch_fn, 4,
+                      checkpoint=str(ck), checkpoint_every=2, warmup=1)
+    assert r2.steps == 0
+
+
+def test_async_writer_durability_and_staging_fallback(tmp_path):
+    """The previous good checkpoint survives every in-flight save (the
+    async write lands in a staging sibling until its flush commits),
+    and a crash inside the promote window still restores — load falls
+    back to a committed staging dir."""
+    import optax
+
+    from kubeshare_tpu.models import mnist
+    from kubeshare_tpu.models.checkpoint import (AsyncCheckpointWriter,
+                                                 load_checkpoint,
+                                                 save_checkpoint)
+
+    key = jax.random.PRNGKey(0)
+    optimizer = optax.adam(1e-3)
+    p = mnist.init(key)
+    s = optimizer.init(p)
+    like_p = mnist.init(jax.random.PRNGKey(9))
+    like_s = optimizer.init(like_p)
+    ck = tmp_path / "ck"
+
+    w = AsyncCheckpointWriter()
+    w.save(ck, p, s, step=1)
+    w.wait()                               # flushed AND promoted
+    w.save(ck, p, s, step=2)               # in staging until next op
+    _, _, at = load_checkpoint(ck, like_p, like_s)
+    assert at == 1, "main checkpoint must stay intact during a flush"
+    w.close()
+    _, _, at = load_checkpoint(ck, like_p, like_s)
+    assert at == 2
+
+    # promote-window crash: only a committed staging sibling exists
+    ck2 = tmp_path / "ck2"
+    save_checkpoint(str(ck2) + ".staging", p, s, step=7)
+    _, _, at = load_checkpoint(ck2, like_p, like_s)
+    assert at == 7
