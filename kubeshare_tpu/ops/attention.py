@@ -50,7 +50,8 @@ def expand_kv(k: jax.Array, v: jax.Array, heads: int):
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = True,
-                          scale: float | None = None) -> jax.Array:
+                          scale: float | None = None,
+                          window: int | None = None) -> jax.Array:
     """Dense reference attention.
 
     ``q``: (batch, q_len, heads, head_dim); ``k``/``v``: (batch, kv_len,
@@ -60,8 +61,19 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     k/v head); this reference expands k/v for clarity, the Pallas
     kernel (:mod:`.flash_attention`) instead maps the group in its
     block index arithmetic so the smaller k/v never grows in HBM.
+    ``window`` = sliding-window (local) attention: with ``causal``,
+    query i sees keys in ``(i - window, i]`` — the Mistral-style band.
     The ring implementation is validated against this function.
     """
+    if window is not None:
+        # validate BEFORE any compute, mirroring the flash kernel's
+        # _blocks: window=0 would silently mask everything (uniform
+        # softmax over MASK_VALUE rows = garbage output)
+        if not causal:
+            raise ValueError("window requires causal=True (the band is "
+                             "defined looking back from each query)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     d = q.shape[-1]
     k, v = expand_kv(k, v, q.shape[2])
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
@@ -74,6 +86,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # convention).
         qidx = jnp.arange(nq) + (nk - nq)
         mask = qidx[:, None] >= jnp.arange(nk)[None, :]
+        if window is not None:
+            mask &= (qidx[:, None] - jnp.arange(nk)[None, :]) < window
         scores = jnp.where(mask[None, :, None, :], scores, MASK_VALUE)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bqhk,bkhd->bqhd", weights, v.astype(jnp.float32))

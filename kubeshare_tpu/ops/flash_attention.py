@@ -44,10 +44,11 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _score_tile(q_ref, k_ref, j, kk, block_q, block_k, causal, scale):
+def _score_tile(q_ref, k_ref, j, kk, block_q, block_k, causal, scale,
+                window=None):
     """One (bq × bk) masked score tile — the ONLY place the score matmul
-    and causal mask live: the backward's P recompute must match the
-    forward's softmax bit-for-bit, so both call this."""
+    and causal/band mask live: the backward's P recompute must match
+    the forward's softmax bit-for-bit, so both call this."""
     qs = q_ref[0].astype(jnp.float32) * scale
     kb = k_ref[0].astype(jnp.float32)
     sc = jax.lax.dot_general(qs, kb, (((1,), (1,)), ((), ())),
@@ -57,13 +58,28 @@ def _score_tile(q_ref, k_ref, j, kk, block_q, block_k, causal, scale):
             jnp.int32, (block_q, 1), 0)
         kpos = kk * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        sc = jnp.where(qpos >= kpos, sc, MASK_VALUE)
+        mask = qpos >= kpos
+        if window is not None:
+            # sliding window: query i sees keys in (i - window, i]
+            mask = jnp.logical_and(mask, qpos - kpos < window)
+        sc = jnp.where(mask, sc, MASK_VALUE)
     return sc, qs, kb
+
+
+def _live_fwd(j, kk, block_q, block_k, causal, window):
+    """Does k block ``kk`` intersect q block ``j``'s visible band?"""
+    live = jnp.logical_or(not causal, kk * block_k <= (j + 1) * block_q - 1)
+    if window is not None:
+        # the block's LAST key must be within the window of the block's
+        # first query: kk·bk + bk − 1 > j·bq − window
+        live = jnp.logical_and(
+            live, (kk + 1) * block_k - 1 > j * block_q - window)
+    return live
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
             block_q: int, block_k: int, n_k: int, causal: bool,
-            scale: float):
+            scale: float, window: int | None = None):
     """One (q-block, k-block) step. Scratch m/l/acc carry across the
     innermost (k) grid dimension."""
     j = pl.program_id(1)          # q block
@@ -78,13 +94,12 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     # Causal: the whole k block is masked iff its first row starts after
     # the q block's last query. Predicating the update off skips the two
     # matmuls — about half the causal FLOPs.
-    q_end = (j + 1) * block_q - 1
-    live = jnp.logical_or(not causal, kk * block_k <= q_end)
+    live = _live_fwd(j, kk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _update():
         sc, _qs, _kb = _score_tile(q_ref, k_ref, j, kk, block_q, block_k,
-                                   causal, scale)          # (bq, bk)
+                                   causal, scale, window)  # (bq, bk)
         vb = v_ref[0].astype(jnp.float32)
         m = m_ref[:]
         m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
@@ -123,7 +138,13 @@ def _vma(*xs):
     return frozenset().union(*(jax.typeof(x).vma for x in xs))
 
 
-def _blocks(s_q, s_kv, block_q, block_k, causal):
+def _blocks(s_q, s_kv, block_q, block_k, causal, window=None):
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (the band is "
+                             "defined looking back from each query)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if causal and s_q != s_kv:
         raise ValueError(f"causal needs equal q/kv lengths, got {s_q}/{s_kv}"
                          " (mask positions are same-origin)")
@@ -151,12 +172,12 @@ def _kv_row_map(h, hk):
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret"))
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+                                    "interpret", "window"))
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
     b, s_q, h, d = q.shape
     s_kv, hk = k.shape[1], k.shape[2]
     scale = 1.0 / math.sqrt(d)
-    bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal)
+    bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal, window)
     kvrow = _kv_row_map(h, hk)
     n_k = s_kv // bk
     qr, kr, vr = _fold(q), _fold(k), _fold(v)
@@ -164,7 +185,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
     out, lse = pl.pallas_call(
         functools.partial(_kernel, block_q=bq, block_k=bk, n_k=n_k,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, window=window),
         grid=(b * h, s_q // bq, n_k),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
@@ -190,18 +211,19 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, j, kk, block_q, block_k, causal,
-                 scale):
+                 scale, window=None):
     """Shared by both backward kernels: rebuild one (bq × bk) probability
     tile from q, k and the saved logsumexp — no running max needed.
     Masked entries: exp(MASK_VALUE - L) underflows to exactly 0."""
     sc, qs, kb = _score_tile(q_ref, k_ref, j, kk, block_q, block_k,
-                             causal, scale)
+                             causal, scale, window)
     return jnp.exp(sc - lse_ref[0]), qs, kb
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
                    dq_acc, *, block_q: int, block_k: int, n_k: int,
-                   causal: bool, scale: float):
+                   causal: bool, scale: float,
+                   window: int | None = None):
     """dQ pass: one q block owns the sequential k loop, so dq_acc has a
     single writer. dS = P ∘ (dO·Vᵀ − D); dQ = scale · dS·K."""
     j = pl.program_id(1)          # q block
@@ -211,13 +233,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q_end = (j + 1) * block_q - 1
-    live = jnp.logical_or(not causal, kk * block_k <= q_end)
+    live = _live_fwd(j, kk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _update():
         p, _qs, kb = _recompute_p(q_ref, k_ref, lse_ref, j, kk,
-                                  block_q, block_k, causal, scale)
+                                  block_q, block_k, causal, scale, window)
         vb = v_ref[0].astype(jnp.float32)
         dob = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
@@ -235,7 +256,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
                     block_k: int, n_q: int, group: int, causal: bool,
-                    scale: float):
+                    scale: float, window: int | None = None):
     """dK/dV pass: one K/V ROW (kv head) owns the sequential inner loop
     ``t = g·n_q + qq`` over its GROUP of q heads × q blocks, so the GQA
     group sum happens in the VMEM accumulator and the outputs stay
@@ -251,15 +272,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # Causal: a q block contributes iff its LAST query can see this k
-    # block's first key.
-    live = jnp.logical_or(not causal,
-                          (qq + 1) * block_q - 1 >= jj * block_k)
+    # Same band-liveness as the forward/dQ passes with the roles
+    # swapped: does q block qq intersect k block jj's visible band?
+    live = _live_fwd(qq, jj, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _update():
         p, qs, _kb = _recompute_p(q_ref, k_ref, lse_ref, qq, jj,
-                                  block_q, block_k, causal, scale)
+                                  block_q, block_k, causal, scale, window)
         vb = v_ref[0].astype(jnp.float32)
         dob = do_ref[0].astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
@@ -280,13 +300,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret"))
+                                    "interpret", "window"))
 def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
-               interpret):
+               interpret, window=None):
     b, s_q, h, d = q.shape
     s_kv, hk = k.shape[1], k.shape[2]
     scale = 1.0 / math.sqrt(d)
-    bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal)
+    bq, bk = _blocks(s_q, s_kv, block_q, block_k, causal, window)
     kvrow = _kv_row_map(h, hk)
     n_q, n_k = s_q // bq, s_kv // bk
     vma = _vma(q, k, v, o, lse, g)
@@ -309,7 +329,7 @@ def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk, n_k=n_k,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, window=window),
         grid=(b * h, n_q, n_k),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
@@ -335,7 +355,8 @@ def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
                             lambda i, jj, t: (qrow(i, t), t % n_q, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk, n_q=n_q,
-                          group=group, causal=causal, scale=scale),
+                          group=group, causal=causal, scale=scale,
+                          window=window),
         grid=(b * hk, n_k, group * n_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
@@ -351,45 +372,51 @@ def _flash_bwd(q, k, v, o, lse, g, g_lse, causal, block_q, block_k,
     return _unfold(dq, b, h), _unfold(dk, b, hk), _unfold(dv, b, hk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window):
+    out, _lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                           window)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, window):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                          window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, window, res, g):
     q, k, v, out, lse = res
     return _flash_bwd(q, k, v, out, lse, g, None, causal, block_q, block_k,
-                      interpret)
+                      interpret, window)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret, window):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                          window)
     b, s, h, _ = q.shape
     return out, lse.reshape(b, h, s).transpose(0, 2, 1)
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret,
+                       window):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret,
+                          window)
     b, s, h, _ = q.shape
     return (out, lse.reshape(b, h, s).transpose(0, 2, 1)), \
         (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, window, res,
+                       g):
     q, k, v, out, lse = res
     g_out, g_lse = g
     return _flash_bwd(q, k, v, out, lse, g_out, g_lse, causal, block_q,
-                      block_k, interpret)
+                      block_k, interpret, window)
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -398,7 +425,8 @@ _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = BLOCK_Q,
                     block_k: int = BLOCK_K,
-                    interpret: bool | None = None) -> jax.Array:
+                    interpret: bool | None = None,
+                    window: int | None = None) -> jax.Array:
     """Drop-in for :func:`~kubeshare_tpu.ops.attention.dot_product_attention`
     (same (batch, seq, heads, head_dim) layout, fp32 output).
 
@@ -407,6 +435,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     arithmetic (``_kv_row_map``), so the smaller k/v is never expanded
     in HBM.
 
+    ``window`` (requires ``causal``) = sliding-window attention: query
+    ``i`` sees keys in ``(i - window, i]``. Off-band BLOCKS are
+    predicated off entirely, so compute scales with seq·window, not
+    seq² — the Mistral-style band at kernel cost. Composes with
+    ulysses (full sequence per device after the head exchange); the
+    RING path stays full-causal (its per-step switch has no global
+    offsets).
+
     ``interpret=None`` auto-selects: compiled on TPU, interpreter
     elsewhere (the interpreter runs the identical kernel body, so CPU CI
     covers it bit-for-bit). Plug into ``mha_apply(attn_fn=...)`` /
@@ -414,13 +450,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    return _flash(q, k, v, causal, block_q, block_k, bool(interpret))
+    return _flash(q, k, v, causal, block_q, block_k, bool(interpret),
+                  window)
 
 
 def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True, block_q: int = BLOCK_Q,
                         block_k: int = BLOCK_K,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        window: int | None = None):
     """:func:`flash_attention` that ALSO returns the per-row logsumexp
     ``lse[b, i, h] = log Σ_j exp(q_i·k_j·scale)`` (fp32, masked keys
     excluded). Partial attentions over disjoint key sets merge exactly::
@@ -433,4 +471,5 @@ def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     (the lse cotangent folds into the same backward kernels)."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    return _flash_lse(q, k, v, causal, block_q, block_k, bool(interpret))
+    return _flash_lse(q, k, v, causal, block_q, block_k, bool(interpret),
+                      window)

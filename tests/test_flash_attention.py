@@ -157,3 +157,51 @@ def test_flash_plugs_into_mha():
                         q, k, v, block_q=16, block_k=16))
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
                                atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("window", [1, 5, 16, 40, 64])
+def test_flash_sliding_window_matches_dense(window):
+    """Band widths below/at/above the block size, including the full
+    sequence (window >= seq == plain causal)."""
+    q, k, v = qkv()
+    ref = dot_product_attention(q, k, v, causal=True, window=window)
+    out = flash_attention(q, k, v, block_q=16, block_k=16, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_flash_sliding_window_gradients_match_dense():
+    q, k, v = qkv(s=32)
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, window=7) ** 2).sum()
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, block_q=8, block_k=8,
+                                window=7) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_sliding_window_with_gqa():
+    q, _, _ = qkv(h=4)
+    keys = jax.random.split(jax.random.PRNGKey(11), 2)
+    k, v = (jax.random.normal(kk, (2, 64, 2, 16), jnp.float32)
+            for kk in keys)
+    ref = dot_product_attention(q, k, v, causal=True, window=10)
+    out = flash_attention(q, k, v, block_q=16, block_k=16, window=10)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_flash_window_requires_causal():
+    q, k, v = qkv()
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8,
+                        block_q=16, block_k=16)
+    with pytest.raises(ValueError, match=">= 1"):
+        flash_attention(q, k, v, window=0, block_q=16, block_k=16)

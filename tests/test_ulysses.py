@@ -160,3 +160,21 @@ def test_ulysses_custom_attn_fn_owns_masking():
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_sliding_window_flash_matches_dense():
+    """Sliding-window attention rides ulysses unchanged: after the
+    head/sequence exchange each device holds the FULL sequence, so the
+    kernel's band positions are already global (the ring path, whose
+    per-step blocks have shifted origins, stays full-causal)."""
+    from functools import partial
+    from kubeshare_tpu.ops.flash_attention import flash_attention
+    q, k, v = qkv()
+    ref = dot_product_attention(q, k, v, causal=True, window=9)
+    ul = make_ulysses_attention(
+        mesh3(), causal=False,
+        attn_fn=partial(flash_attention, causal=True, window=9,
+                        block_q=8, block_k=8))
+    out = jax.jit(ul)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
