@@ -93,6 +93,37 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bqhk,bkhd->bqhd", weights, v.astype(jnp.float32))
 
 
+def rope(x: jax.Array, positions: jax.Array | None = None,
+         base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (RoPE) over the head dimension.
+
+    ``x``: (batch, seq, heads, head_dim), head_dim even. Each feature
+    pair ``(x[i], x[i + d/2])`` rotates by ``pos · base^(-2i/d)`` —
+    attention scores between rotated q/k then depend only on RELATIVE
+    position, the property that lets windows slide and contexts extend
+    (no learned position table to outgrow). Parameter-free, so it adds
+    nothing to checkpoints; applied to q AND k before any ``attn_fn``,
+    it composes unchanged with the flash kernel, GQA, sliding windows,
+    ring and ulysses (rotation happens on the global arrays under jit —
+    sequence sharding just shards the position iota).
+    """
+    b, s, h, d = x.shape
+    if d % 2:
+        raise ValueError(f"rope needs an even head_dim, got {d}")
+    if positions is None:
+        positions = jnp.arange(s)
+    # arange(0, d, 2) is already 2i — dividing by d gives the standard
+    # base^(-2i/d) wavelength ladder (Llama/Mistral-compatible)
+    freqs = base ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]    # (1, s, 1, d/2)
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
 def mha_init(key, dim: int, heads: int, kv_heads: int | None = None) -> dict:
     """Fused-QKV multi-head attention parameters (heads must divide dim).
 
@@ -115,11 +146,12 @@ def mha_init(key, dim: int, heads: int, kv_heads: int | None = None) -> dict:
 
 
 def mha_apply(params: dict, x: jax.Array, heads: int, causal: bool = True,
-              attn_fn=None, dtype=None) -> jax.Array:
+              attn_fn=None, dtype=None, use_rope: bool = False) -> jax.Array:
     """Multi-head self-attention over ``x``: (batch, seq, dim).
 
     ``attn_fn(q, k, v)`` defaults to causal :func:`dot_product_attention`;
     the sequence-parallel path passes a ring-attention closure instead.
+    ``use_rope`` rotates q/k with :func:`rope` before the attention body.
     The kv head count is read off the ``qkv`` weight's shape, so grouped-
     query blocks (``mha_init(kv_heads=...)``) need no extra argument.
     """
@@ -136,6 +168,8 @@ def mha_apply(params: dict, x: jax.Array, heads: int, causal: bool = True,
     q = qkv[..., :dim].reshape(b, s, heads, hd)
     k = qkv[..., dim:dim + kvd].reshape(b, s, kv_heads, hd)
     v = qkv[..., dim + kvd:].reshape(b, s, kv_heads, hd)
+    if use_rope:
+        q, k = rope(q), rope(k)
     if attn_fn is None:
         o = dot_product_attention(q, k, v, causal=causal)
     else:

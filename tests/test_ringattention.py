@@ -293,3 +293,19 @@ def test_transformer_train_step_sp_grads_flow():
     for _ in range(3):
         params, opt_state, loss, _ = step(params, opt_state, batch)
     assert float(loss) < float(loss0)
+
+
+def test_mha_rope_under_sequence_parallel_matches_dense():
+    """RoPE happens on the global arrays under jit, so the sequence
+    sharding shards the position iota with the tokens — ring attention
+    with rotated q/k must equal the single-device rotated dense path."""
+    m = mesh3(dp=2, sp=4)
+    params = mha_init(jax.random.PRNGKey(0), dim=16, heads=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 16))
+    dense = mha_apply(params, x, heads=2, use_rope=True)
+    ring = make_ring_attention(m)
+    xs = jax.device_put(x, NamedSharding(m, P("dp", "sp", None)))
+    out = jax.jit(lambda p, x: mha_apply(p, x, heads=2, use_rope=True,
+                                         attn_fn=ring))(params, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=1e-4, rtol=1e-4)
