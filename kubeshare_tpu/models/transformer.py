@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..ops import (dense_apply, dense_init, layernorm_apply, layernorm_init,
                    mha_apply, mha_init, softmax_cross_entropy)
+from ..ops.attention import dot_product_attention
 from .common import main_cli, synthetic_token_batch
 
 BATCH_SIZE = 8
@@ -37,6 +38,20 @@ if os.environ.get("KUBESHARE_TPU_TRANSFORMER_PRESET", "") == "small":
     # divisibility (sp/tp/heads/dp) preserved.
     BATCH_SIZE, SEQ_LEN, VOCAB, DIM, HEADS, LAYERS = 4, 32, 64, 32, 4, 2
 
+# Modern-LM attention knobs (env-configured like the preset; 0/off =
+# the classic full-causal multi-head block):
+#   KV_HEADS < HEADS  -> grouped-query / multi-query attention (smaller
+#                        fused projection + kv cache; changes the
+#                        checkpoint shape, so set it consistently)
+#   ROPE              -> rotary positions on q/k (parameter-free)
+#   WINDOW > 0        -> sliding-window (local) attention band
+KV_HEADS = int(os.environ.get("KUBESHARE_TPU_TRANSFORMER_KV_HEADS", "0")) \
+    or None
+USE_ROPE = os.environ.get("KUBESHARE_TPU_TRANSFORMER_ROPE", "").lower() in \
+    ("1", "true", "yes", "on")
+WINDOW = int(os.environ.get("KUBESHARE_TPU_TRANSFORMER_WINDOW", "0")) \
+    or None
+
 
 def init(key, *, seq_len: int = SEQ_LEN, vocab: int = VOCAB, dim: int = DIM,
          layers: int = LAYERS, n_experts: int = 0) -> dict:
@@ -52,7 +67,7 @@ def init(key, *, seq_len: int = SEQ_LEN, vocab: int = VOCAB, dim: int = DIM,
         k1, k2, k3 = jax.random.split(lkey, 3)
         block = {
             "ln1": layernorm_init(dim),
-            "attn": mha_init(k1, dim, HEADS),
+            "attn": mha_init(k1, dim, HEADS, kv_heads=KV_HEADS),
             "ln2": layernorm_init(dim),
         }
         if n_experts:
@@ -84,11 +99,25 @@ def apply(params: dict, tokens: jax.Array, attn_fn=None,
     from ..ops.moe import moe_apply
 
     seq = tokens.shape[1]
-    x = (params["embed"][tokens] + params["pos"][:seq]).astype(DTYPE)
+    x = params["embed"][tokens]
+    if not USE_ROPE:
+        # learned absolute positions (and their seq_len cap); RoPE
+        # REPLACES them — rotating q/k while also adding this table
+        # would forfeit the relative-position property RoPE exists for
+        # (the table still lives in the checkpoint for shape stability)
+        x = x + params["pos"][:seq]
+    x = x.astype(DTYPE)
+    if attn_fn is None and WINDOW is not None:
+        # the band lives in the LOCAL attention body; the sp strategies
+        # own their masking (only the ulysses pair supports a band —
+        # see _loss_for_mesh)
+        attn_fn = partial(dot_product_attention, causal=True,
+                          window=WINDOW)
     aux_total = jnp.zeros((), jnp.float32)
     for blk in params["blocks"]:
         x = x + mha_apply(blk["attn"], layernorm_apply(blk["ln1"], x),
                           HEADS, causal=True, attn_fn=attn_fn,
+                          use_rope=USE_ROPE,
                           dtype=DTYPE).astype(DTYPE)
         hin = layernorm_apply(blk["ln2"], x)
         if "moe" in blk:
@@ -140,13 +169,30 @@ def _loss_for_mesh(mesh):
         raise ValueError(
             f"KUBESHARE_TPU_SP_ATTN={kind!r}: want ring | ring_flash | "
             "ulysses | ulysses_flash")
+    if WINDOW is not None and kind in ("ring", "ring_flash"):
+        # the ring's per-step blocks have shifted origins, so the band
+        # cannot ride it; ulysses sees the full sequence per device
+        raise ValueError(
+            f"KUBESHARE_TPU_TRANSFORMER_WINDOW={WINDOW} needs an "
+            "ulysses strategy (KUBESHARE_TPU_SP_ATTN=ulysses[_flash], "
+            "which in turn needs heads AND kv_heads divisible by sp); "
+            f"the {kind} path is full-causal — windowed attention with "
+            "kv_heads not divisible by sp is unsupported under "
+            "sequence parallelism")
     if kind in ("ulysses", "ulysses_flash"):
         from ..parallel.ulysses import make_ulysses_attention
         if kind == "ulysses_flash":
             from ..ops.flash_attention import flash_attention
             attn = make_ulysses_attention(
                 mesh, causal=False,
-                attn_fn=partial(flash_attention, causal=True))
+                attn_fn=partial(flash_attention, causal=True,
+                                window=WINDOW))
+        elif WINDOW is not None:
+            from ..ops.attention import dot_product_attention
+            attn = make_ulysses_attention(
+                mesh, causal=False,
+                attn_fn=partial(dot_product_attention, causal=True,
+                                window=WINDOW))
         else:
             attn = make_ulysses_attention(mesh)
     elif kind == "ring_flash":

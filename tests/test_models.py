@@ -250,3 +250,43 @@ def test_async_writer_durability_and_staging_fallback(tmp_path):
     save_checkpoint(str(ck2) + ".staging", p, s, step=7)
     _, _, at = load_checkpoint(ck2, like_p, like_s)
     assert at == 7
+
+
+def test_transformer_modern_lm_knobs(tmp_path):
+    """GQA + RoPE + sliding window as env config on the flagship family
+    (knobs are read at import, so drive the real CLI in a subprocess)."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    REPO = Path(__file__).resolve().parent.parent
+    env = dict(os.environ,
+               KUBESHARE_TPU_TRANSFORMER_PRESET="small",
+               KUBESHARE_TPU_TRANSFORMER_KV_HEADS="2",
+               KUBESHARE_TPU_TRANSFORMER_ROPE="1",
+               KUBESHARE_TPU_TRANSFORMER_WINDOW="8")
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubeshare_tpu.models.transformer",
+         "--steps", "3", "--platform", "cpu"],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=str(REPO))
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    assert "final loss" in proc.stdout
+
+    # the band refuses the ring strategies loudly (full-causal only)
+    check = subprocess.run(
+        [sys.executable, "-c",
+         "from kubeshare_tpu.utils.virtualcpu import force_virtual_cpu;"
+         "force_virtual_cpu(4);"
+         "import numpy as np, jax;"
+         "from jax.sharding import Mesh;"
+         "from kubeshare_tpu.models import transformer;"
+         "m = Mesh(np.array(jax.devices('cpu')[:4]).reshape(1, 4, 1),"
+         "         ('dp', 'sp', 'tp'));"
+         "transformer.MESH_HOOKS['loss'](m)"],
+        capture_output=True, text=True,
+        env=dict(env, KUBESHARE_TPU_SP_ATTN="ring_flash"),
+        timeout=120, cwd=str(REPO))
+    assert check.returncode != 0
+    assert "ulysses" in check.stderr
