@@ -124,10 +124,26 @@ class RemoteLoop:
         #: iterations the proxy actually ran on the last call — it may clamp
         #: a long burst to keep one dispatch near the scheduling quantum.
         self.last_n = 0
+        #: the per-burst clamp inside the last chain() call (equals
+        #: last_n for plain calls) — the burst controller's steady state
+        self.last_burst = 0
 
     def __call__(self, n: int, carry, *consts):
+        return self._dispatch(int(n), carry, consts, chain=False)
+
+    def chain(self, n: int, carry, *consts):
+        """Run toward ``n`` iterations with SERVER-SIDE burst chaining:
+        the proxy re-feeds each token-gated burst's carry into the next,
+        so the per-burst client round trip (the turnaround that idles
+        the chip when the co-tenant is token-blocked) disappears. May
+        stop early (bounded bursts per call) — ``last_n`` reports the
+        steps actually run; call again for the remainder. Fairness is
+        unchanged: every burst passes the token gate individually."""
+        return self._dispatch(int(n), carry, consts, chain=True)
+
+    def _dispatch(self, n: int, carry, consts, chain: bool):
         import jax
-        if int(n) < 1:
+        if n < 1:
             # Clamping 0 → 1 would silently apply an extra step to the
             # carry; a true 0-iteration call can't exist (the carry would
             # have to pass through untouched).
@@ -137,9 +153,10 @@ class RemoteLoop:
             raise TypeError("RemoteLoop args must be device-resident "
                             "(put them first)")
         carry_handles = [b.handle for b in leaves[:self._ncarry]]
-        handles, self.last_n = self._client._execute_n(
+        handles, self.last_n, self.last_burst = self._client._execute_n(
             self._exec_id, [b.handle for b in leaves],
-            donate=carry_handles, repeat=int(n))
+            donate=carry_handles,
+            **({"chain_steps": n} if chain else {"repeat": n}))
         out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
                     for h, (shape, dtype) in zip(handles, self.out_meta)]
         return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
@@ -326,12 +343,17 @@ class ProxyClient:
         return self._execute_n(exec_id, handles, donate, repeat)[0]
 
     def _execute_n(self, exec_id: int, handles: list[int],
-                   donate=(), repeat: int = 1) -> tuple[list[int], int]:
-        reply, _ = self._conn.call({"op": "execute", "name": self.name,
-                                    "exec_id": exec_id, "args": handles,
-                                    "donate": list(donate),
-                                    "repeat": repeat})
-        return list(reply["handles"]), int(reply.get("repeat", repeat))
+                   donate=(), repeat: int = 1,
+                   chain_steps: int = 0) -> tuple[list[int], int, int]:
+        msg = {"op": "execute", "name": self.name, "exec_id": exec_id,
+               "args": handles, "donate": list(donate)}
+        if chain_steps:
+            msg["chain_steps"] = chain_steps
+        else:
+            msg["repeat"] = repeat
+        reply, _ = self._conn.call(msg)
+        n = int(reply.get("repeat", repeat))
+        return list(reply["handles"]), n, int(reply.get("burst", n))
 
     def usage(self) -> dict:
         reply, _ = self._conn.call({"op": "usage", "name": self.name})

@@ -746,3 +746,146 @@ def test_put_payload_not_copied_on_send():
     data = parts[1]
     assert isinstance(data, memoryview)
     assert data.obj is arr  # same backing memory — zero-copy
+
+
+def test_chained_loop_matches_stepwise(proxy):
+    """loop.chain(n, ...) must land on exactly the state n sequential
+    steps produce — the server-side burst chaining changes dispatch
+    shape, never math. The reply reports real steps (clamped chains
+    are continued by asking again)."""
+    def step(w, x):
+        return w + x, (w ** 2).sum()
+
+    with connect(proxy, "chain-a") as c:
+        w0 = np.zeros(4, np.float32)
+        x = np.full(4, 0.5, np.float32)
+        wa = c.put(w0.copy())
+        xa = c.put(x)
+        loop = c.compile_loop(step, wa, xa)
+        done = 0
+        carry = wa
+        while done < 37:
+            carry, aux = loop.chain(37 - done, carry, xa)
+            assert loop.last_n >= 1
+            done += loop.last_n
+            if done < 37:
+                c.free(aux)
+        assert done == 37
+        np.testing.assert_allclose(c.get(carry), w0 + 37 * x)
+        np.testing.assert_allclose(float(c.get(aux)),
+                                   ((w0 + 36 * x) ** 2).sum())
+        u = c.usage()
+        assert u["exec_count"] >= 1     # every burst charged the gate
+
+
+def test_chained_loop_shares_stay_fair(proxy):
+    """Two co-located chained clients still split device time by their
+    equal requests — chaining must not let one client hold the chip
+    past its quota (every burst renews at the gate)."""
+    import jax.numpy as jnp
+
+    def step(w, x):
+        return w + jnp.tanh(w) * 0.01 + x * 0.0, (w ** 2).sum()
+
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def trainer(name):
+        with connect(proxy, name, request=0.5, limit=1.0) as c:
+            w = c.put(np.ones((64, 64), np.float32))
+            x = c.put(np.zeros((64, 64), np.float32))
+            loop = c.compile_loop(step, w, x)
+            carry, aux = loop(1, w, x)   # seed the cost model
+            c.free(aux)
+            barrier.wait()
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                carry, aux = loop.chain(512, carry, x)
+                c.free(aux)
+            results[name] = c.usage()["exec_ms_total"]
+
+    ts = [threading.Thread(target=trainer, args=(f"fair-{i}",))
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = sum(results.values())
+    assert total > 0
+    share = max(results.values()) / total
+    assert share <= 0.65, results      # ~50/50 within tolerance
+
+
+def test_chained_loop_fails_clean_before_first_burst(proxy):
+    """A failure BEFORE any burst dispatched leaves every buffer
+    intact (normal error, nothing consumed)."""
+    def step(w, x):
+        return w / x, w.sum()
+
+    with connect(proxy, "chain-err") as c:
+        w = c.put(np.ones(4, np.float32))
+        bad = c.put(np.zeros(4, np.float32))
+        loop = c.compile_loop(step, w, bad)
+        # division by zero doesn't raise in XLA; use a shape trap instead:
+        # free the const out from under the chain via a second handle? No —
+        # simplest deterministic failure: kill the executable's args by
+        # freeing the const first, so the chain's arg fetch fails fast
+        # BEFORE any burst (buffers intact, normal error).
+        c.free(bad)
+        with pytest.raises(RuntimeError):
+            loop.chain(8, w, bad)
+        # w was NOT consumed (failure before burst 0): still usable
+        np.testing.assert_allclose(c.get(w), np.ones(4, np.float32))
+
+
+def test_chained_loop_midchain_failure_consumes_carry(proxy, monkeypatch):
+    """A failure AFTER the first burst reports the consumed carry (the
+    donated handles are popped, HBM accounting stays clean) — the
+    single-burst loop path's contract, chained."""
+    def step(w, x):
+        return w + x, w.sum()
+
+    with connect(proxy, "chain-mid") as c:
+        w = c.put(np.ones(4, np.float32))
+        x = c.put(np.full(4, 0.5, np.float32))
+        loop = c.compile_loop(step, w, x)
+
+        calls = {"n": 0}
+        real = proxy._run_fn
+
+        def flaky(fn, args, timing=None):
+            calls["n"] += 1
+            if calls["n"] > 1:           # burst 0 succeeds, burst 1 dies
+                raise RuntimeError("injected device failure")
+            return real(fn, args, timing)
+
+        monkeypatch.setattr(proxy, "_run_fn", flaky)
+        with pytest.raises(RuntimeError, match="carry was consumed"):
+            loop.chain(10_000, w, x)
+        assert calls["n"] == 2
+        # the donated carry handle is gone; the const survives
+        with pytest.raises(RuntimeError):
+            c.get(w)
+        np.testing.assert_allclose(c.get(x), np.full(4, 0.5, np.float32))
+        assert c.usage()["hbm_used"] == x.nbytes
+
+
+def test_chained_loop_hbm_cap_returns_partial(proxy):
+    """Running out of HBM mid-chain returns the VALID partial chain
+    (steps done so far) instead of erroring — the client just sees a
+    shorter chain and decides what to free."""
+    def step(w, x):
+        return w + x, (w * 2.0)          # aux same size as carry
+
+    # cap: w(16)+x(16) resident, one out-set charge (32) fits (64<=72);
+    # after burst 0 the donated w releases 16 (48), and burst 1's charge
+    # (80>72) trips the cap with bursts>0 -> partial return, not error
+    with connect(proxy, "chain-cap", memory=72) as c:
+        w = c.put(np.zeros(4, np.float32))
+        x = c.put(np.full(4, 1.0, np.float32))
+        loop = c.compile_loop(step, w, x)
+        carry, aux = loop.chain(10_000, w, x)
+        # progress was made, the chain stopped early, the reply is usable
+        assert 1 <= loop.last_n < 10_000
+        got = c.get(carry)
+        np.testing.assert_allclose(got, np.full(4, float(loop.last_n)))
