@@ -778,6 +778,7 @@ def test_chained_loop_matches_stepwise(proxy):
         assert u["exec_count"] >= 1     # every burst charged the gate
 
 
+@pytest.mark.slow  # 3s measured co-location phase
 def test_chained_loop_shares_stay_fair(proxy):
     """Two co-located chained clients still split device time by their
     equal requests — chaining must not let one client hold the chip
