@@ -10,6 +10,7 @@ per-chip bookings, free fractions, and the pods on each chip.
 Usage::
 
     python -m kubeshare_tpu.topcli [--registry HOST:PORT] [--node N]
+                                   [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
@@ -28,13 +29,28 @@ from . import constants as C
 from .telemetry.registry import RegistryClient
 
 
-def snapshot(client: RegistryClient, node: str | None = None) -> dict:
+def snapshot(client: RegistryClient, node: str | None = None,
+             scheduler=None) -> dict:
     """One coherent fleet view: capacity + pods joined per chip (pods
-    filtered server-side via ``/pods?node=``)."""
+    filtered server-side via ``/pods?node=``). With a scheduler client,
+    outstanding preemption requests annotate their victims."""
     capacity = client.capacity()
     pods = client.pods(node)
+    evictions: list = []
+    if scheduler is not None:
+        try:
+            evictions = scheduler.evictions()
+        except Exception as exc:
+            # render the fleet anyway, but say the markers are missing —
+            # a silently-dead scheduler endpoint would hide in-flight
+            # preemptions for the whole session
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "eviction markers unavailable", file=sys.stderr)
     if node is not None:
         capacity = {n: v for n, v in capacity.items() if n == node}
+        evictions = [e for e in evictions if e.get("node") == node]
+    evicting = {e.get("victim"): e.get("preemptor", "?")
+                for e in evictions}
 
     now = time.time()
     nodes = []
@@ -64,7 +80,8 @@ def snapshot(client: RegistryClient, node: str | None = None) -> dict:
                           "request": r.get("request", "?"),
                           "limit": r.get("limit", "?"),
                           "priority": r.get("priority", "0"),
-                          "group": r.get("group_name", "")}
+                          "group": r.get("group_name", ""),
+                          "evicting_for": evicting.get(k, "")}
                          for k, r in residents],
             })
             total_chips += 1
@@ -76,9 +93,11 @@ def snapshot(client: RegistryClient, node: str | None = None) -> dict:
     groups = {r.get("group_name") for r in pods.values()
               if r.get("group_name")}
     return {"nodes": nodes,
+            "evictions": evictions,
             "fleet": {"chips": total_chips,
                       "booked": round(booked_total, 3),
-                      "pods": len(pods), "gangs": len(groups)}}
+                      "pods": len(pods), "gangs": len(groups),
+                      "evicting": len(evictions)}}
 
 
 def _opportunistic(priority: str) -> bool:
@@ -100,7 +119,9 @@ def render(snap: dict) -> str:
             residents = ", ".join(
                 f"{p['key']}({p['request']}/{p['limit']}"
                 + (f" g={p['group']}" if p["group"] else "")
-                + (" opp" if _opportunistic(p["priority"]) else "") + ")"
+                + (" opp" if _opportunistic(p["priority"]) else "")
+                + (f" EVICTING→{p['evicting_for']}"
+                   if p.get("evicting_for") else "") + ")"
                 for p in c["pods"]) or "-"
             lines.append(
                 f"  {c['chip_id']:<28} {c['model']:<12} "
@@ -110,7 +131,9 @@ def render(snap: dict) -> str:
     pct = 100.0 * f["booked"] / f["chips"] if f["chips"] else 0.0
     lines.append(f"FLEET: {f['chips']} chips, {f['booked']}/{f['chips']} "
                  f"booked ({pct:.0f}%), {f['pods']} pods, "
-                 f"{f['gangs']} gangs")
+                 f"{f['gangs']} gangs"
+                 + (f", {f['evicting']} evicting" if f.get("evicting")
+                    else ""))
     return "\n".join(lines)
 
 
@@ -123,6 +146,9 @@ def main(argv=None) -> int:
                              "service port, deploy/registry.yaml)")
     parser.add_argument("--node", default=None,
                         help="show one node only")
+    parser.add_argument("--scheduler", default="",
+                        help="scheduler HOST:PORT — annotate pods under "
+                             "an outstanding preemption (/evictions)")
     parser.add_argument("--watch", type=float, default=0.0,
                         help="refresh every N seconds (0 = one shot)")
     parser.add_argument("--json", action="store_true",
@@ -130,11 +156,18 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     host, _, port = args.registry.rpartition(":")
     client = RegistryClient(host or "127.0.0.1", int(port))
+    scheduler = None
+    if args.scheduler:
+        from .scheduler.bridge import ServiceClient
+        base = (args.scheduler if "://" in args.scheduler
+                else "http://" + args.scheduler)
+        # advisory call: a hung scheduler must not stall --watch frames
+        scheduler = ServiceClient(base, timeout=3.0)
 
     try:
         while True:
             try:
-                snap = snapshot(client, args.node)
+                snap = snapshot(client, args.node, scheduler)
             except (urllib.error.URLError, OSError, ValueError) as exc:
                 print(f"kubeshare-top: registry {args.registry} "
                       f"unreachable: {exc}", file=sys.stderr)

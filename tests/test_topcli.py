@@ -33,7 +33,7 @@ def test_snapshot_joins_capacity_and_pods():
         snap = topcli.snapshot(
             RegistryClient("127.0.0.1", srv.server_address[1]))
         assert snap["fleet"] == {"chips": 4, "booked": 1.0, "pods": 2,
-                                 "gangs": 1}
+                                 "gangs": 1, "evicting": 0}
         node0 = next(n for n in snap["nodes"] if n["node"] == "tpu-host-0")
         chip = next(c for c in node0["chips"] if c["chip_id"] == first)
         assert chip["booked"] == 1.0 and chip["free"] == 0.0
@@ -65,3 +65,36 @@ def test_cli_renders_and_filters(capsys):
 def test_cli_unreachable_registry_exits_2(capsys):
     assert topcli.main(["--registry", "127.0.0.1:1"]) == 2
     assert "unreachable" in capsys.readouterr().err
+
+
+def test_cli_annotates_outstanding_evictions(capsys):
+    """--scheduler surfaces the dispatcher's preemption plans: victims
+    render EVICTING with their preemptor."""
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.service import SchedulerService
+    from kubeshare_tpu.topology.discovery import FakeTopology
+    from kubeshare_tpu import constants as C
+
+    reg = TelemetryRegistry()
+    eng = SchedulerEngine()
+    chip = FakeTopology(hosts=1, mesh=(1,)).chips()[0]
+    reg.put_capacity(chip.host, [chip.to_labels()])
+    svc = SchedulerService(eng, reg, replay=False)
+    svc.serve()
+    rsrv = reg.serve()
+    try:
+        svc.schedule("ns", "opp", {C.POD_TPU_REQUEST: "1",
+                                   C.POD_TPU_LIMIT: "1"})
+        svc.schedule("ns", "guar", {C.POD_TPU_REQUEST: "1",
+                                    C.POD_TPU_LIMIT: "1",
+                                    C.POD_PRIORITY: "50"})
+        assert svc.dispatcher.evictions()
+        addr = f"127.0.0.1:{rsrv.server_address[1]}"
+        assert topcli.main(["--registry", addr, "--scheduler",
+                            f"127.0.0.1:{svc.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "EVICTING" in out and "ns/guar" in out
+        assert "1 evicting" in out
+    finally:
+        svc.close()
+        rsrv.shutdown()
