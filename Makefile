@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench image clean
+.PHONY: all native test bench image clean obs-check
 
 all: native
 
@@ -29,6 +29,13 @@ test-all:
 
 test-slow:
 	$(PY) -m pytest tests/ -x -q -m slow
+
+# Observability plane gate: exposition-format lint + trace-propagation
+# tests, then the self-validating 3-pod smoke (doc/observability.md) —
+# fails on any malformed exposition or unstitched trace.
+obs-check:
+	$(PY) -m pytest tests/test_obs.py tests/test_trace_propagation.py -x -q
+	$(PY) scripts/trace_demo.py
 
 bench:
 	$(PY) bench.py

@@ -167,13 +167,14 @@ class ProxyClient:
 
     def __init__(self, host: str, port: int, name: str, request: float,
                  limit: float, memory: int = 0, timeout: float | None = None,
-                 chunk_bytes: int = 64 << 20):
+                 chunk_bytes: int = 64 << 20, trace_id: str = ""):
         self.name = name
         #: transfer slab size for put/get; arrays whose serialized form
         #: exceeds it stream in slices, so checkpoint-sized buffers cross a
         #: wire whose frame cap is far smaller than the buffer.
         self.chunk_bytes = chunk_bytes
-        self._conn = protocol.Connection(host, port, timeout=timeout)
+        self._conn = protocol.Connection(host, port, timeout=timeout,
+                                         trace_id=trace_id)
         reply, _ = self._conn.call({
             "op": "register", "name": name, "request": request,
             "limit": limit, "memory": memory})
@@ -576,9 +577,13 @@ class ExecutionGate:
 
     @classmethod
     def connect(cls, host: str, port: int, name: str, request: float,
-                limit: float) -> "ExecutionGate":
-        """Dial a pod manager / token scheduler and register."""
-        conn = protocol.Connection(host, port)
+                limit: float, trace_id: str = "") -> "ExecutionGate":
+        """Dial a pod manager / token scheduler and register.
+
+        ``trace_id`` (the pod's, from the scheduler binding) rides every
+        message so server-side token-grant spans join the pod's timeline.
+        """
+        conn = protocol.Connection(host, port, trace_id=trace_id)
         conn.call({"op": "register", "name": name, "request": request,
                    "limit": limit})
         return cls(conn, name)

@@ -20,6 +20,12 @@ import threading
 _HDR = struct.Struct(">I")
 MAX_FRAME = 1 << 30
 
+#: reserved message key carrying the sender's trace ID (obs/trace.py).
+#: Like ``_blob`` it is transport metadata, not part of any op's schema:
+#: stripped server-side into ``state["trace_id"]`` before dispatch, so
+#: one pod's timeline stitches across the client/proxy/tokensched hops.
+TRACE_KEY = "_trace"
+
 
 def dump_array_parts(arr) -> list:
     """numpy array → ``[npy header bytes, raw data buffer]``.
@@ -172,12 +178,16 @@ def recv_msg(sock: socket.socket) -> tuple[dict, bytearray | None]:
 class Connection:
     """Client-side request/reply channel."""
 
-    def __init__(self, host: str, port: int, timeout: float | None = None):
+    def __init__(self, host: str, port: int, timeout: float | None = None,
+                 trace_id: str = ""):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.trace_id = trace_id
         self._lock = threading.Lock()
 
     def call(self, msg: dict, blob=None) -> tuple[dict, bytearray | None]:
+        if self.trace_id and TRACE_KEY not in msg:
+            msg = dict(msg, **{TRACE_KEY: self.trace_id})
         with self._lock:
             try:
                 send_msg(self.sock, msg, blob)
@@ -233,6 +243,8 @@ def serve_framed(host: str, port: int, handle, cleanup=None) -> FramedServer:
                         break
                     state["blob"] = blob
                     state.pop("reply_blob", None)
+                    if TRACE_KEY in msg:
+                        state["trace_id"] = str(msg.pop(TRACE_KEY))
                     try:
                         reply = handle(msg, state)
                     except Exception as e:  # surfaced to the caller

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..utils.logger import get_logger
 from . import protocol
 from .protocol import dump_array, load_array
@@ -40,6 +41,14 @@ from .tokensched import TokenScheduler
 log = get_logger("proxy")
 
 IDLE_RELEASE_MS = 10.0
+
+_KNOWN_OPS = frozenset((
+    "register", "put", "put_begin", "put_chunk", "put_commit", "put_abort",
+    "get", "free", "compile", "execute", "usage", "unregister"))
+_RPC_LAT = obs_metrics.default_registry().histogram(
+    "kubeshare_proxy_rpc_latency_seconds",
+    "Chip-proxy RPC handling wall time per op (token waits and device "
+    "time included).", labels=("op",))
 
 
 def _now_ms() -> float:
@@ -105,6 +114,9 @@ class _Session:
     # uploads for `put_begin`/`put_chunk`/`put_commit`.
     fetch_cache: tuple[int, bytes] | None = None
     staging: dict[int, tuple[int, bytearray]] = field(default_factory=dict)
+    #: trace ID propagated by the client at register (protocol TRACE_KEY);
+    #: handed to the token scheduler so grant-waits join the pod's timeline
+    trace_id: str = ""
 
     def fresh_id(self) -> int:
         self.next_id += 1
@@ -176,7 +188,8 @@ class ChipProxy:
         self._jax = jax
         self.device = device if device is not None else jax.devices()[0]
         self.platform = self.device.platform
-        self.scheduler = scheduler if scheduler is not None else TokenScheduler()
+        self.scheduler = (scheduler if scheduler is not None
+                          else TokenScheduler(chip=str(self.device)))
         self.idle_release_ms = idle_release_ms
         self._sessions: dict[str, _Session] = {}
         self._slock = threading.Lock()
@@ -203,7 +216,8 @@ class ChipProxy:
     # -- lifecycle -----------------------------------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> protocol.FramedServer:
-        self._server = protocol.serve_framed(host, port, self._handle, self._cleanup)
+        self._server = protocol.serve_framed(host, port, self._handle_timed,
+                                             self._cleanup)
         self._watchdog = threading.Thread(target=self._watch_idle, daemon=True,
                                           name="proxy-idle-watchdog")
         self._watchdog.start()
@@ -300,9 +314,11 @@ class ChipProxy:
             used = sess.used_ms
         try:
             if not holding:
-                quota = self.scheduler.acquire(sess.name)
+                quota = self.scheduler.acquire(sess.name,
+                                               trace_id=sess.trace_id)
             elif exhausted:
-                quota = self.scheduler.renew(sess.name, used)
+                quota = self.scheduler.renew(sess.name, used,
+                                             trace_id=sess.trace_id)
             else:
                 quota = None
             if quota is not None:
@@ -350,6 +366,17 @@ class ChipProxy:
 
     # -- protocol ------------------------------------------------------------
 
+    def _handle_timed(self, req: dict, state: dict) -> dict:
+        op = str(req.get("op"))
+        t0 = time.perf_counter()
+        try:
+            return self._handle(req, state)
+        finally:
+            # unknown ops share one label — a misbehaving client must not
+            # mint unbounded series
+            _RPC_LAT.observe(op if op in _KNOWN_OPS else "other",
+                             value=time.perf_counter() - t0)
+
     def _handle(self, req: dict, state: dict) -> dict:
         op = req.get("op")
         if op == "register":
@@ -359,8 +386,10 @@ class ChipProxy:
                 raise ValueError(
                     f"connection already registered as {state['name']!r}")
             name = req["name"]
-            self._register(name, float(req["request"]), float(req["limit"]),
-                           int(req.get("memory", 0)))
+            sess = self._register(name, float(req["request"]),
+                                  float(req["limit"]),
+                                  int(req.get("memory", 0)))
+            sess.trace_id = state.get("trace_id", "")
             state["name"] = name
             return {"ok": True, "platforms": [self.platform],
                     "device": str(self.device)}

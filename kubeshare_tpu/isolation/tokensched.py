@@ -23,6 +23,8 @@ import time
 from dataclasses import dataclass, field
 
 from ..constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
+from ..obs import metrics as obs_metrics
+from ..obs.trace import get_tracer
 from ..utils.logger import get_logger
 from . import protocol
 from .native import load_library
@@ -30,6 +32,21 @@ from .native import load_library
 log = get_logger("tokensched")
 
 _INF = float("inf")
+
+_OBS = obs_metrics.default_registry()
+_GRANT_WAIT = _OBS.histogram(
+    "kubeshare_token_grant_wait_seconds",
+    "Time a client blocked between requesting the chip token and the "
+    "grant.", labels=("chip",))
+_HOLD = _OBS.histogram(
+    "kubeshare_token_hold_seconds",
+    "Wall time a client held the chip token before releasing it.",
+    labels=("chip",))
+_UTIL = _OBS.gauge(
+    "kubeshare_token_utilization_ratio",
+    "Per-client share of the sliding window actually consumed "
+    "(window_usage / window_ms), updated at each release.",
+    labels=("chip", "client"))
 
 
 # --------------------------------------------------------------------------
@@ -287,13 +304,15 @@ class TokenScheduler:
     def __init__(self, window_ms: float = WINDOW_MS,
                  base_quota_ms: float = BASE_QUOTA_MS,
                  min_quota_ms: float = MIN_QUOTA_MS, native: bool | None = None,
-                 clock=None):
+                 clock=None, chip: str = ""):
         self._core = make_core(window_ms, base_quota_ms, min_quota_ms, native)
         self._cond = threading.Condition()
         self._grants: dict[str, float] = {}  # name -> granted quota_ms
         self._waiting: set[str] = set()      # names with a blocked waiter
+        self._held_since: dict[str, float] = {}  # name -> grant wall time
         self._clock = clock or _now_ms
         self.window_ms = window_ms
+        self.chip = chip or "chip"           # metric label for this token
 
     @property
     def core(self):
@@ -307,14 +326,19 @@ class TokenScheduler:
         with self._cond:
             self._core.remove_client(name)
             self._grants.pop(name, None)
+            self._held_since.pop(name, None)
             self._cond.notify_all()
 
-    def acquire(self, name: str, timeout: float | None = None) -> float:
+    def acquire(self, name: str, timeout: float | None = None,
+                trace_id: str = "") -> float:
         """Block until *name* is granted the token; returns quota_ms."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             self._core.request_token(name)
-            return self._wait_for_grant(name, deadline)
+            t0 = time.monotonic()
+            quota = self._wait_for_grant(name, deadline)
+            self._note_grant(name, time.monotonic() - t0, trace_id)
+            return quota
 
     def _enter_wait(self, name: str) -> None:
         # A client is one token stream: a second concurrent waiter for the
@@ -324,7 +348,8 @@ class TokenScheduler:
             raise RuntimeError(f"{name}: token request already in flight")
         self._waiting.add(name)
 
-    def renew(self, name: str, used_ms: float, timeout: float | None = None) -> float:
+    def renew(self, name: str, used_ms: float, timeout: float | None = None,
+              trace_id: str = "") -> float:
         """Atomically release + re-request + wait for the next grant.
 
         This is the steady-state client call (≙ the hook re-requesting when
@@ -338,9 +363,13 @@ class TokenScheduler:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             self._core.release_token(name, used_ms, self._clock())
+            self._note_release(name)
             self._core.request_token(name)
             self._cond.notify_all()
-            return self._wait_for_grant(name, deadline)
+            t0 = time.monotonic()
+            quota = self._wait_for_grant(name, deadline)
+            self._note_grant(name, time.monotonic() - t0, trace_id)
+            return quota
 
     def _wait_for_grant(self, name: str, deadline: float | None) -> float:
         # Caller holds self._cond and has already requested the token.
@@ -382,9 +411,33 @@ class TokenScheduler:
         finally:
             self._waiting.discard(name)
 
+    def _note_grant(self, name: str, wait_s: float, trace_id: str) -> None:
+        # caller holds self._cond; a timed-out wait raised before this
+        _GRANT_WAIT.observe(self.chip, value=wait_s)
+        self._held_since[name] = time.monotonic()
+        if trace_id:
+            tracer = get_tracer()
+            end = tracer.now_ms()
+            tracer.record("token-grant", trace_id,
+                          end - wait_s * 1000.0, end,
+                          client=name, chip=self.chip)
+
+    def _note_release(self, name: str) -> None:
+        # caller holds self._cond, AFTER release_token so the utilization
+        # gauge includes the usage interval just reported
+        since = self._held_since.pop(name, None)
+        if since is not None:
+            _HOLD.observe(self.chip, value=time.monotonic() - since)
+        try:
+            usage = self._core.window_usage(name, self._clock())
+        except (KeyError, RuntimeError):
+            return
+        _UTIL.set(self.chip, name, value=usage / self.window_ms)
+
     def release(self, name: str, used_ms: float) -> None:
         with self._cond:
             self._core.release_token(name, used_ms, self._clock())
+            self._note_release(name)
             self._cond.notify_all()
 
     def window_usage(self, name: str) -> float:
@@ -442,11 +495,13 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
         if not name:
             raise PermissionError("connection not bound (register/attach first)")
         if op == "acquire":
-            quota = scheduler.acquire(name, timeout=req.get("timeout"))
+            quota = scheduler.acquire(name, timeout=req.get("timeout"),
+                                      trace_id=state.get("trace_id", ""))
             return {"ok": True, "quota_ms": quota}
         if op == "renew":
             quota = scheduler.renew(name, float(req["used_ms"]),
-                                    timeout=req.get("timeout"))
+                                    timeout=req.get("timeout"),
+                                    trace_id=state.get("trace_id", ""))
             return {"ok": True, "quota_ms": quota}
         if op == "release":
             scheduler.release(name, float(req["used_ms"]))

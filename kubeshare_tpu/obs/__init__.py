@@ -1,0 +1,40 @@
+"""Self-observability plane: in-process metrics + span tracing.
+
+The telemetry registry (:mod:`..telemetry`) carries *cluster state* —
+``tpu_capacity``/``tpu_requirement``, the decision inputs. This package
+carries the system's view of **itself**: where a pod spent its time
+between submit and bind, how long tenants wait for the chip token, what
+the proxy's RPC latencies look like. The reference has neither (its only
+scheduler observability is log lines, SURVEY §5) — which is exactly how
+its 5-10 s Prometheus staleness bug stayed hidden.
+
+Two halves:
+
+- :mod:`.metrics` — labeled Counter/Gauge/Histogram primitives with a
+  strict Prometheus exposition renderer (``# HELP``/``# TYPE`` headers).
+  One process-wide default registry; every component records into it and
+  every ``/metrics`` endpoint appends its rendering.
+- :mod:`.trace` — lightweight spans (context managers, monotonic clocks,
+  trace IDs) with a JSONL sink and a Chrome trace-event exporter
+  (Perfetto-loadable). Trace IDs thread submit → bind → token grant
+  through the isolation protocol (``_trace`` message key), so one pod's
+  timeline stitches end-to-end across layers.
+
+See ``doc/observability.md`` for the full metric/span catalogue.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      default_registry, lint_exposition, parse_exposition,
+                      prom_escape, quantile_from_buckets, render_default,
+                      render_help_type, render_sample)
+from .trace import (Span, Tracer, get_tracer, install_tracer, new_trace_id,
+                    tracing_enabled, uninstall_tracer)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry", "lint_exposition", "parse_exposition",
+    "prom_escape", "quantile_from_buckets", "render_default",
+    "render_help_type", "render_sample",
+    "Span", "Tracer", "get_tracer", "install_tracer", "new_trace_id",
+    "tracing_enabled", "uninstall_tracer",
+]

@@ -1,0 +1,395 @@
+"""In-process labeled metrics with a strict Prometheus exposition renderer.
+
+This is the single exposition code path for the whole system:
+``telemetry/registry.py`` and ``telemetry/collector.py`` render their
+``tpu_capacity``/``tpu_requirement`` families through :func:`render_sample`
+and :func:`render_help_type`, and append :func:`render_default` so every
+``/metrics`` endpoint also serves the process's self-metrics.
+
+No external deps — the stdlib is enough for counters, gauges, and
+cumulative-bucket histograms, and keeps the hot-path record cost at a
+dict lookup plus a float add under one lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# -- exposition rendering ----------------------------------------------------
+
+_LABEL_ESCAPES = {"\\": r"\\", '"': r"\"", "\n": r"\n"}
+
+
+def prom_escape(value) -> str:
+    """Escape a label value per the Prometheus text format (v0.0.4)."""
+    return "".join(_LABEL_ESCAPES.get(ch, ch) for ch in str(value))
+
+
+def render_sample(name: str, labels: Optional[dict], value) -> str:
+    """One sample line: ``name{k="v",...} value`` (no trailing newline)."""
+    if labels:
+        body = ",".join('%s="%s"' % (k, prom_escape(v))
+                        for k, v in sorted(labels.items()))
+        return "%s{%s} %s" % (name, body, _fmt_value(value))
+    return "%s %s" % (name, _fmt_value(value))
+
+
+def render_help_type(name: str, mtype: str, help_text: str) -> List[str]:
+    """``# HELP`` / ``# TYPE`` header lines for one metric family."""
+    return [
+        "# HELP %s %s" % (name, help_text.replace("\\", r"\\")
+                          .replace("\n", r"\n")),
+        "# TYPE %s %s" % (name, mtype),
+    ]
+
+
+def _fmt_value(value) -> str:
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_le(bound: float) -> str:
+    return "+Inf" if bound == math.inf else _fmt_value(bound)
+
+
+# -- metric primitives -------------------------------------------------------
+
+# Latency buckets in seconds: sub-millisecond scheduler phases up to
+# multi-second token waits under contention.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, math.inf)
+
+
+class _Metric:
+    """Base: one named family with a fixed label-key schema."""
+
+    mtype = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 labels: Sequence[str] = ()):
+        self.name = name
+        self.help_text = help_text
+        self.label_keys = tuple(labels)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], object] = {}
+
+    def _key(self, label_values: Sequence[str]) -> Tuple[str, ...]:
+        values = tuple(str(v) for v in label_values)
+        if len(values) != len(self.label_keys):
+            raise ValueError("%s expects labels %r, got %r"
+                             % (self.name, self.label_keys, values))
+        return values
+
+    def _labels_dict(self, key: Tuple[str, ...]) -> dict:
+        return dict(zip(self.label_keys, key))
+
+    def render(self) -> List[str]:
+        lines = render_help_type(self.name, self.mtype, self.help_text)
+        with self._lock:
+            series = sorted(self._series.items())
+        for key, value in series:
+            lines.extend(self._render_series(self._labels_dict(key), value))
+        return lines
+
+    def _render_series(self, labels: dict, value) -> List[str]:
+        return [render_sample(self.name, labels, value)]
+
+
+class Counter(_Metric):
+    """Monotonically increasing count."""
+
+    mtype = "counter"
+
+    def inc(self, *label_values, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counter %s cannot decrease" % self.name)
+        key = self._key(label_values)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, *label_values) -> float:
+        key = self._key(label_values)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+
+class Gauge(_Metric):
+    """Point-in-time value that can go up or down."""
+
+    mtype = "gauge"
+
+    def set(self, *label_values, value: float) -> None:
+        key = self._key(label_values)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, *label_values, amount: float = 1.0) -> None:
+        key = self._key(label_values)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, *label_values) -> float:
+        key = self._key(label_values)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+
+class _HistSeries:
+    __slots__ = ("counts", "total", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets   # per-bucket (non-cumulative)
+        self.total = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (``_bucket``/``_sum``/``_count``)."""
+
+    mtype = "histogram"
+
+    def __init__(self, name: str, help_text: str,
+                 labels: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text, labels)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds or bounds[-1] != math.inf:
+            bounds.append(math.inf)
+        self.buckets = tuple(bounds)
+
+    def observe(self, *label_values, value: float) -> None:
+        key = self._key(label_values)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = _HistSeries(len(self.buckets))
+            series.counts[idx] += 1
+            series.total += value
+            series.count += 1
+
+    def snapshot(self, *label_values):
+        """(cumulative bucket counts, sum, count) — for quantile math."""
+        key = self._key(label_values)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                return [0] * len(self.buckets), 0.0, 0
+            cumulative, running = [], 0
+            for c in series.counts:
+                running += c
+                cumulative.append(running)
+            return cumulative, series.total, series.count
+
+    def _render_series(self, labels: dict, series: _HistSeries) -> List[str]:
+        lines, running = [], 0
+        for bound, c in zip(self.buckets, series.counts):
+            running += c
+            bucket_labels = dict(labels)
+            bucket_labels["le"] = _fmt_le(bound)
+            lines.append(render_sample(self.name + "_bucket",
+                                       bucket_labels, running))
+        lines.append(render_sample(self.name + "_sum", labels, series.total))
+        lines.append(render_sample(self.name + "_count", labels,
+                                   series.count))
+        return lines
+
+
+def quantile_from_buckets(buckets: Sequence[float],
+                          cumulative: Sequence[int],
+                          q: float) -> float:
+    """Estimate quantile ``q`` by linear interpolation within buckets.
+
+    Mirrors PromQL's ``histogram_quantile``: the +Inf bucket clamps to
+    the previous finite bound rather than extrapolating.
+    """
+    total = cumulative[-1] if cumulative else 0
+    if total == 0:
+        return float("nan")
+    rank = q * total
+    for i, cum in enumerate(cumulative):
+        if cum >= rank:
+            upper = buckets[i]
+            lower = buckets[i - 1] if i > 0 else 0.0
+            if upper == math.inf:
+                return lower if i > 0 else float("nan")
+            prev_cum = cumulative[i - 1] if i > 0 else 0
+            in_bucket = cum - prev_cum
+            if in_bucket == 0:
+                return upper
+            return lower + (upper - lower) * (rank - prev_cum) / in_bucket
+    return buckets[-2] if len(buckets) > 1 else float("nan")
+
+
+# -- registry ----------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+class MetricsRegistry:
+    """Named families with idempotent getters.
+
+    ``counter()/gauge()/histogram()`` return the existing family when the
+    name is already registered, so instrumentation sites can declare
+    their families at import time without coordination.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help_text, labels, **kwargs):
+        if not _NAME_RE.match(name):
+            raise ValueError("invalid metric name %r" % name)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError("metric %s already registered as %s"
+                                     % (name, existing.mtype))
+                return existing
+            metric = cls(name, help_text, labels, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str,
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labels)
+
+    def gauge(self, name: str, help_text: str,
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labels)
+
+    def histogram(self, name: str, help_text: str,
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labels,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def families(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def render(self) -> str:
+        """Full exposition text for this registry (trailing newline)."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for metric in metrics:
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def reset(self) -> None:
+        """Drop all families — test isolation only."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every instrumentation site records into."""
+    return _DEFAULT
+
+
+def render_default() -> str:
+    return _DEFAULT.render()
+
+
+# -- exposition parsing (topcli + lint tests) --------------------------------
+
+HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$")
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\[\\\"n])*\",?)*)\})?"
+    r" (NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)"
+    r"(?: [0-9]+)?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\[\\"n])*)"')
+
+
+def _unescape(value: str) -> str:
+    return (value.replace(r"\n", "\n").replace(r"\"", '"')
+            .replace(r"\\", "\\"))
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """Parse exposition text into ``{family: {type, help, samples}}``.
+
+    ``samples`` is a list of ``(name, labels_dict, value)``; histogram
+    ``_bucket``/``_sum``/``_count`` samples attach to their base family.
+    Raises ``ValueError`` on any malformed line — this doubles as the
+    lint used by tests and ``scripts/trace_demo.py``.
+    """
+    families: Dict[str, dict] = {}
+
+    def family(name: str) -> dict:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in families:
+                base = name[:-len(suffix)]
+                break
+        return families.setdefault(
+            base, {"type": None, "help": None, "samples": []})
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        m = HELP_RE.match(line)
+        if m:
+            family(m.group(1))["help"] = m.group(2)
+            continue
+        m = TYPE_RE.match(line)
+        if m:
+            family(m.group(1))["type"] = m.group(2)
+            continue
+        if line.startswith("#"):      # bare comments are legal, skipped
+            continue
+        m = SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError("malformed exposition line %d: %r"
+                             % (lineno, line))
+        name, raw_labels, raw_value = m.group(1), m.group(2), m.group(3)
+        labels = {k: _unescape(v)
+                  for k, v in _LABEL_RE.findall(raw_labels or "")}
+        value = float(raw_value.replace("Inf", "inf"))
+        family(name)["samples"].append((name, labels, value))
+    return families
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Return lint errors (empty list == clean).
+
+    Beyond line grammar: every family with samples must carry both a
+    ``# HELP`` and a ``# TYPE`` header.
+    """
+    try:
+        families = parse_exposition(text)
+    except ValueError as exc:
+        return [str(exc)]
+    errors = []
+    for name, fam in sorted(families.items()):
+        if not fam["samples"]:
+            continue
+        if fam["type"] is None:
+            errors.append("family %s has samples but no # TYPE" % name)
+        if fam["help"] is None:
+            errors.append("family %s has samples but no # HELP" % name)
+    return errors

@@ -23,15 +23,63 @@ rendezvous with virtual devices.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import os
+import time
 
 from .. import constants as C
+from ..obs import metrics as obs_metrics
+from ..obs.trace import get_tracer
 from ..utils.logger import get_logger
 
 log = get_logger("runner")
 
 _initialized = False
+
+_STEP_LAT = obs_metrics.default_registry().histogram(
+    "kubeshare_runner_step_seconds",
+    "Wall time of one training/eval step in the gang runner.",
+    labels=("phase",))
+
+
+@contextlib.contextmanager
+def step_timer(phase: str = "train", trace_id: str = "", step: int = -1):
+    """Time one step's wall clock into ``kubeshare_runner_step_seconds``.
+
+    ``phase`` labels the histogram series (train/eval/compile/...);
+    kept to a handful of static values — never interpolate step numbers
+    into it. With a ``trace_id`` (e.g. ``KUBESHARE_TPU_TRACE_ID`` injected
+    at bind) each step also lands as a ``step`` span on the pod's
+    timeline, so per-step stalls line up against token grant-waits.
+    """
+    t0 = time.perf_counter()
+    ts0 = get_tracer().now_ms()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _STEP_LAT.observe(phase, value=dt)
+        if trace_id:
+            tracer = get_tracer()
+            attrs = {"phase": phase}
+            if step >= 0:
+                attrs["step"] = step
+            tracer.record("step", trace_id, ts0, tracer.now_ms(), **attrs)
+
+
+def timed_range(n: int, phase: str = "train", trace_id: str = ""):
+    """``range(n)`` that times each iteration as one step.
+
+    Drop-in for a training loop's ``for step in range(n)`` — every
+    iteration's wall time is observed under ``phase``::
+
+        for step in runner.timed_range(num_steps):
+            state = train_step(state, batch)
+    """
+    for i in range(n):
+        with step_timer(phase, trace_id=trace_id, step=i):
+            yield i
 
 
 def distributed_init_from_env(env: dict | None = None) -> bool:

@@ -31,6 +31,8 @@ import time
 from dataclasses import dataclass, field
 
 from .. import constants as C
+from ..obs import metrics as obs_metrics
+from ..obs.trace import get_tracer
 from ..utils.logger import get_logger
 from .engine import Binding, SchedulerEngine, Unschedulable
 from .labels import PodRequest
@@ -40,6 +42,20 @@ log = get_logger("dispatcher")
 GC_PERIOD_S = 30.0         # scheduler.go:233
 RETRY_BACKOFF_S = 1.0      # unschedulable requeue delay
 MAX_RESULTS = 4096         # resolved-outcome retention (live pods exempt)
+
+_OBS = obs_metrics.default_registry()
+_QUEUE_WAIT = _OBS.histogram(
+    "kubeshare_sched_queue_wait_seconds",
+    "Pod submit (or last requeue) to successful reservation.")
+_GANG_WAIT = _OBS.histogram(
+    "kubeshare_sched_gang_wait_seconds",
+    "Time a reserved gang member spent parked at the Permit barrier.")
+_BIND_LAT = _OBS.histogram(
+    "kubeshare_sched_bind_latency_seconds",
+    "Reservation to bound outcome (binding publish + permit).")
+_REQUEUES = _OBS.counter(
+    "kubeshare_sched_requeues_total",
+    "Pods requeued with backoff after an unschedulable cycle.")
 
 
 @dataclass
@@ -62,6 +78,7 @@ class _Parked:
     pod: PodRequest
     binding: Binding
     deadline: float
+    since: float = 0.0            # parked-at, for the gang-wait metric
 
 
 def _binding_of(pod: PodRequest) -> Binding:
@@ -269,6 +286,8 @@ class Dispatcher:
         return best
 
     def _cycle(self, pod: PodRequest, now: float) -> None:
+        tracer = get_tracer()
+        parent = pod.trace_span.span_id if pod.trace_span else ""
         ok, msg = self.engine.pre_filter(pod)
         if not ok:
             self._requeue(pod, now, msg)
@@ -280,6 +299,20 @@ class Dispatcher:
                 return
             self._requeue(pod, now, str(e))
             return
+        # queue-wait ends the moment a reservation succeeded. The wait is
+        # measured on the scheduler clock (injectable in tests); the span
+        # is back-dated on the tracer clock, clamped into the root span so
+        # fake-clock durations cannot escape the submit timeline.
+        wait_s = max(0.0, now - pod.timestamp)
+        _QUEUE_WAIT.observe(value=wait_s)
+        wait_end = tracer.now_ms()
+        wait_start = wait_end - wait_s * 1000.0
+        if pod.trace_span is not None:
+            wait_start = max(wait_start, pod.trace_span.start_ms)
+        tracer.record("queue-wait", pod.trace_id, wait_start, wait_end,
+                      parent_id=parent, pod=pod.key)
+        bind_t0 = time.perf_counter()
+        bind_ts0 = tracer.now_ms()
         if self.registry is not None and pod.needs_tpu:
             from ..telemetry.aggregator import publish_binding
 
@@ -293,9 +326,13 @@ class Dispatcher:
                 return
         decision, timeout_s = self.engine.permit(pod)
         if decision == "wait":
-            self._parked[pod.key] = _Parked(pod, binding, now + timeout_s)
+            self._parked[pod.key] = _Parked(pod, binding, now + timeout_s,
+                                            since=now)
             log.info("%s parked at gang barrier (%.1fs)", pod.key, timeout_s)
             return
+        _BIND_LAT.observe(value=time.perf_counter() - bind_t0)
+        tracer.record("bind", pod.trace_id, bind_ts0, tracer.now_ms(),
+                      parent_id=parent, node=binding.node)
         self._resolve(pod.key, Outcome("bound", binding=binding))
         # the pod completing the barrier releases every parked member
         # (Allow all waiting group members, scheduler.go:577-584)
@@ -303,6 +340,18 @@ class Dispatcher:
             for key in [k for k, p in self._parked.items()
                         if p.pod.group_key == pod.group_key]:
                 parked = self._parked.pop(key)
+                gang_s = max(0.0, now - parked.since)
+                _GANG_WAIT.observe(value=gang_s)
+                member = parked.pod
+                end = tracer.now_ms()
+                start = end - gang_s * 1000.0
+                if member.trace_span is not None:
+                    start = max(start, member.trace_span.start_ms)
+                tracer.record(
+                    "gang-wait", member.trace_id, start, end,
+                    parent_id=(member.trace_span.span_id
+                               if member.trace_span else ""),
+                    pod=member.key)
                 self._resolve(key, Outcome("bound", binding=parked.binding))
 
     def _maybe_preempt(self, pod: PodRequest, now: float) -> bool:
@@ -354,6 +403,7 @@ class Dispatcher:
             return [dict(v) for v in self._evict_requested.values()]
 
     def _requeue(self, pod: PodRequest, now: float, reason: str) -> None:
+        _REQUEUES.inc()
         self._pending[pod.key] = pod
         self._retry_at[pod.key] = now + self.retry_backoff_s
         self._last_reason[pod.key] = reason

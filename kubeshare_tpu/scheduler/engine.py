@@ -25,12 +25,15 @@ Extension-point parity map:
 
 from __future__ import annotations
 
+import functools
 import math
 import re
 import time
 from dataclasses import dataclass, field
 
 from .. import constants as C
+from ..obs import metrics as obs_metrics
+from ..obs.trace import get_tracer, new_trace_id
 from ..topology.cell import (CellConstructor, FreeList, build_cell_chains,
                              reclaim_resource, reserve_resource,
                              set_node_status)
@@ -48,6 +51,31 @@ from .scoring import (normalize_scores, score_guarantee_node,
 log = get_logger("scheduler")
 
 PERMIT_WAIT_BASE_S = 2.0  # × headcount (scheduler.go:44,573)
+
+#: per-extension-point wall time. `filter`/`score` are observed once per
+#: scheduling cycle as aggregates over the candidate loop — filter also
+#: runs inside find_preemption's victim simulation, where a per-call
+#: observation would swamp the family with simulation noise.
+_PHASE_LAT = obs_metrics.default_registry().histogram(
+    "kubeshare_sched_phase_latency_seconds",
+    "Scheduler extension-point wall time per scheduling cycle.",
+    labels=("phase",))
+
+
+def _timed_phase(phase: str):
+    """Observe real wall time (perf_counter, never the injectable fake
+    clock) for one extension point."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                _PHASE_LAT.observe(phase,
+                                   value=time.perf_counter() - t0)
+        return wrapper
+    return deco
 
 
 class Unschedulable(RuntimeError):
@@ -273,6 +301,12 @@ class SchedulerEngine:
             # find them).
             self._reclaim(cached)
         pod.timestamp = self._clock()
+        # root span of the pod's timeline: opened here, closed at
+        # delete_pod; everything downstream (queue-wait, filter, reserve,
+        # bind, token-grant) keys off this trace ID
+        pod.trace_id = new_trace_id()
+        pod.trace_span = get_tracer().begin("submit", pod.trace_id,
+                                            pod=pod.key)
         self.pod_status[pod.key] = pod
         self.groups.get_or_create(pod)
         return pod
@@ -297,6 +331,7 @@ class SchedulerEngine:
 
     # -- extension points --------------------------------------------------
 
+    @_timed_phase("pre_filter")
     def pre_filter(self, pod: PodRequest) -> tuple[bool, str]:
         """Gang sanity gate (PreFilter, scheduler.go:275-324); label
         validity was already enforced at parse time."""
@@ -511,6 +546,7 @@ class SchedulerEngine:
 
     normalize_scores = staticmethod(normalize_scores)
 
+    @_timed_phase("reserve")
     def reserve(self, pod: PodRequest, node_name: str) -> Binding:
         """Pick cells, book them, allocate the manager port, emit the
         binding (Reserve, scheduler.go:489-531 + pod.go:348-476)."""
@@ -658,6 +694,7 @@ class SchedulerEngine:
                         ordinals[pod.key], free[0])
         return free[0]
 
+    @_timed_phase("find_preemption")
     def find_preemption(self, pod: PodRequest,
                         nodes: list[str] | None = None) -> dict | None:
         """Victim search for a blocked GUARANTEE pod: the fewest
@@ -813,6 +850,9 @@ class SchedulerEngine:
         pod = self.pod_status.pop(pod_key, None)
         if pod is None:
             return
+        if pod.trace_span is not None:
+            get_tracer().finish(pod.trace_span)
+            pod.trace_span = None
         self._reclaim(pod)
         if pod.group_name and not any(
                 p.group_name == pod.group_name
@@ -882,29 +922,41 @@ class SchedulerEngine:
 
     def schedule(self, pod: PodRequest,
                  nodes: list[str] | None = None) -> Binding:
+        tracer = get_tracer()
+        parent = pod.trace_span.span_id if pod.trace_span else ""
         ok, msg = self.pre_filter(pod)
         if not ok:
             raise Unschedulable(f"{pod.key}: {msg}")
         candidates = []
-        for node in (nodes if nodes is not None else self.nodes):
-            fit, why = self.filter(pod, node)
-            if fit:
-                candidates.append(node)
-            else:
-                log.debug("filter: %s rejected %s: %s", node, pod.key, why)
+        with tracer.span("filter", pod.trace_id, parent) as fspan:
+            t0 = time.perf_counter()
+            for node in (nodes if nodes is not None else self.nodes):
+                fit, why = self.filter(pod, node)
+                if fit:
+                    candidates.append(node)
+                else:
+                    log.debug("filter: %s rejected %s: %s",
+                              node, pod.key, why)
+            _PHASE_LAT.observe("filter", value=time.perf_counter() - t0)
+            fspan.attrs["candidates"] = len(candidates)
         if not candidates:
             raise Unschedulable(f"{pod.key}: no node passed filtering")
+        t0 = time.perf_counter()
         raw = {node: self.score(pod, node) for node in candidates}
         norm = self.normalize_scores(raw)
+        _PHASE_LAT.observe("score", value=time.perf_counter() - t0)
         # Walk candidates best-first: a reserve-time refusal (select_cells
         # sees different constraints than the filter DFS, e.g. raced
         # capacity) falls back to the next-ranked node instead of aborting
         # the whole cycle on a feasible pod.
         last_err: Unschedulable | None = None
-        for node in sorted(candidates, key=lambda n: (norm[n], n),
-                           reverse=True):
-            try:
-                return self.reserve(pod, node)
-            except Unschedulable as err:
-                last_err = err
+        with tracer.span("reserve", pod.trace_id, parent) as rspan:
+            for node in sorted(candidates, key=lambda n: (norm[n], n),
+                               reverse=True):
+                try:
+                    binding = self.reserve(pod, node)
+                    rspan.attrs["node"] = node
+                    return binding
+                except Unschedulable as err:
+                    last_err = err
         raise last_err if last_err is not None else Unschedulable(pod.key)

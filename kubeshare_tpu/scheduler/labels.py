@@ -75,6 +75,12 @@ class PodRequest:
     port: int = 0
     timestamp: float = 0.0        # first-seen time, set by the engine
 
+    # observability: minted at submit, carried through the binding into
+    # the isolation layer (obs/trace.py) — excluded from equality so
+    # two parses of the same labels still compare equal
+    trace_id: str = field(default="", compare=False)
+    trace_span: object = field(default=None, compare=False, repr=False)
+
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

@@ -91,24 +91,34 @@ class SchedulerService:
     def render_metrics(self) -> str:
         """Scheduler-side Prometheus exposition (the reference's only
         scheduler observability is log lines; SURVEY §5). Complements the
-        registry's load-bearing tpu_capacity/tpu_requirement families."""
+        registry's load-bearing tpu_capacity/tpu_requirement families.
+        Appends the process-wide obs registry (phase latencies, queue
+        waits, bind latency, requeues) so one scrape sees everything."""
+        from ..obs.metrics import render_default, render_help_type
         d = self.dispatcher
         with d.lock:
             lines = [
-                "# TYPE kubeshare_scheduler_pending_pods gauge",
+                *render_help_type("kubeshare_scheduler_pending_pods", "gauge",
+                                  "Pods in the Less-ordered pending queue."),
                 f"kubeshare_scheduler_pending_pods {len(d._pending)}",
-                "# TYPE kubeshare_scheduler_parked_pods gauge",
+                *render_help_type("kubeshare_scheduler_parked_pods", "gauge",
+                                  "Pods parked at the gang Permit barrier."),
                 f"kubeshare_scheduler_parked_pods {len(d._parked)}",
-                "# TYPE kubeshare_scheduler_bound_pods gauge",
+                *render_help_type("kubeshare_scheduler_bound_pods", "gauge",
+                                  "Pods currently bound to a node."),
                 "kubeshare_scheduler_bound_pods "
                 f"{sum(1 for p in self.engine.pod_status.values() if p.node_name)}",
-                "# TYPE kubeshare_scheduler_nodes gauge",
+                *render_help_type("kubeshare_scheduler_nodes", "gauge",
+                                  "Nodes known to the scheduler engine."),
                 f"kubeshare_scheduler_nodes {len(self.engine.chips_by_node)}",
-                "# TYPE kubeshare_scheduler_topology_rebuilds_total counter",
+                *render_help_type("kubeshare_scheduler_topology_rebuilds_total",
+                                  "counter",
+                                  "Cell-tree rebuilds triggered by capacity "
+                                  "changes."),
                 "kubeshare_scheduler_topology_rebuilds_total "
                 f"{self.engine.rebuild_count}",
             ]
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" + render_default()
 
     @staticmethod
     def _state_locked(eng: SchedulerEngine) -> dict:

@@ -22,6 +22,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs.metrics import render_default, render_help_type
 from ..topology.discovery import discover_chips
 from ..utils.logger import get_logger
 from .registry import RegistryClient, render_metric
@@ -107,11 +108,14 @@ def serve_metrics(get_chips, node: str, host: str = "0.0.0.0",
                 self.end_headers()
                 return
             now = time.time()
-            lines = ["# TYPE tpu_capacity gauge"]
+            lines = render_help_type(
+                "tpu_capacity", "gauge",
+                "Schedulable chip inventory; chip identity in labels, "
+                "value is the publish timestamp.")
             for chip in get_chips():
                 lines.append(render_metric("tpu_capacity", chip.to_labels(),
                                            now))
-            body = ("\n".join(lines) + "\n").encode()
+            body = ("\n".join(lines) + "\n" + render_default()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))

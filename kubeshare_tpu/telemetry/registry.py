@@ -36,25 +36,28 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
+from ..obs import metrics as obs_metrics
+# One exposition code path for the whole system: the canonical renderer
+# lives in obs.metrics; these names stay importable here for callers
+# that predate the obs package (collector.py, external tools).
+from ..obs.metrics import prom_escape as _prom_escape  # noqa: F401
+from ..obs.metrics import render_help_type, render_sample as render_metric
 from ..utils.logger import get_logger
 
 log = get_logger("registry")
 
-
-def _prom_escape(value: str) -> str:
-    return value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
-
-
-def render_metric(name: str, labels: dict, value: float) -> str:
-    inner = ",".join(f'{k}="{_prom_escape(str(v))}"'
-                     for k, v in sorted(labels.items()))
-    return f"{name}{{{inner}}} {value}"
+_RETRIES = obs_metrics.default_registry().counter(
+    "kubeshare_registry_client_retries_total",
+    "RegistryClient HTTP attempts retried after a transient failure.",
+    labels=("op",))
 
 
 class TelemetryRegistry:
@@ -207,18 +210,25 @@ class TelemetryRegistry:
 
     def render_metrics(self) -> str:
         """Prometheus exposition, reference metric shapes
-        (collector.go:30-35, aggregator.go:22-39) under TPU names."""
-        lines = ["# TYPE tpu_capacity gauge"]
+        (collector.go:30-35, aggregator.go:22-39) under TPU names, plus
+        the process's self-metrics from the obs default registry."""
+        lines = render_help_type(
+            "tpu_capacity", "gauge",
+            "Schedulable chip inventory; chip identity in labels, "
+            "value is the publish timestamp.")
         for node, entry in self.capacity().items():
             for chip in entry["chips"]:
                 lines.append(render_metric("tpu_capacity", chip, entry["ts"]))
-        lines.append("# TYPE tpu_requirement gauge")
+        lines.extend(render_help_type(
+            "tpu_requirement", "gauge",
+            "Bound pod requirements; binding record in labels, "
+            "value is the bind timestamp."))
         for key, rec in self.pods().items():
             labels = {k: v for k, v in rec.items() if k != "ts"}
             ns, _, name = key.partition("/")
             labels.update({"namespace": ns, "pod": name})
             lines.append(render_metric("tpu_requirement", labels, rec["ts"]))
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" + obs_metrics.render_default()
 
     # -- HTTP server -------------------------------------------------------
 
@@ -312,11 +322,41 @@ class TelemetryRegistry:
 
 
 class RegistryClient:
-    """Thin HTTP client for the registry."""
+    """Thin HTTP client for the registry.
+
+    Transient transport failures (connection refused during a registry
+    restart, socket timeouts) are retried with jittered backoff so a
+    capacity/requirement update is not silently dropped mid-push. HTTP
+    error *responses* are not retried — the registry answered, and
+    replaying a 4xx/5xx would not change it.
+    """
+
+    RETRY_ATTEMPTS = 3
+    RETRY_BACKOFF_S = 0.05
 
     def __init__(self, host: str, port: int, timeout: float = 5.0):
         self._base = f"http://{host}:{port}"
         self._timeout = timeout
+        self._open = urllib.request.urlopen   # injectable for tests
+
+    def _fetch(self, req: urllib.request.Request, op: str) -> bytes:
+        last_exc: Exception = OSError("unreachable")
+        for attempt in range(self.RETRY_ATTEMPTS):
+            if attempt:
+                _RETRIES.inc(op)
+                time.sleep(self.RETRY_BACKOFF_S * (2 ** (attempt - 1))
+                           * (0.5 + random.random()))
+            try:
+                with self._open(req, timeout=self._timeout) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError:
+                raise                 # the registry answered; don't replay
+            except (urllib.error.URLError, OSError) as exc:
+                last_exc = exc
+                log.warning("registry %s %s attempt %d/%d failed: %s",
+                            req.get_method(), req.selector, attempt + 1,
+                            self.RETRY_ATTEMPTS, exc)
+        raise last_exc
 
     def _request(self, method: str, path: str, body: dict | None = None):
         data = None if body is None else json.dumps(body).encode()
@@ -324,8 +364,9 @@ class RegistryClient:
                                      method=method)
         if data is not None:
             req.add_header("Content-Type", "application/json")
-        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
-            payload = resp.read()
+        # coarse op label (method + collection) to bound label cardinality
+        op = f"{method} /{path.strip('/').split('/')[0].split('?')[0]}"
+        payload = self._fetch(req, op=op)
         return json.loads(payload) if payload else {}
 
     def put_capacity(self, node: str, chips: list[dict],
@@ -351,8 +392,7 @@ class RegistryClient:
 
     def metrics(self) -> str:
         req = urllib.request.Request(self._base + "/metrics")
-        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
-            return resp.read().decode()
+        return self._fetch(req, op="GET /metrics").decode()
 
 
 def main(argv=None) -> None:

@@ -11,9 +11,14 @@ Usage::
 
     python -m kubeshare_tpu.topcli [--registry HOST:PORT] [--node N]
                                    [--scheduler HOST:PORT]
-                                   [--watch SECONDS] [--json]
+                                   [--watch SECONDS] [--json] [--latency]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
+``--latency`` switches from the fleet table to the self-observability
+view: phase-latency percentiles (p50/p90/p99 from the exposition's
+histogram buckets, ``doc/observability.md``) plus per-chip token
+utilization — scraped from the scheduler's ``/metrics`` when
+``--scheduler`` is given, else the registry's.
 Exit 0 on a healthy read, 2 when the registry is unreachable.
 """
 
@@ -100,6 +105,96 @@ def snapshot(client: RegistryClient, node: str | None = None,
                       "evicting": len(evictions)}}
 
 
+def _fmt_seconds(s: float) -> str:
+    if s != s:                       # NaN: series exists but has no samples
+        return "-"
+    if s < 0.001:
+        return f"{s * 1e6:.0f}µs"
+    if s < 1.0:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s:.2f}s"
+
+
+def latency_snapshot(text: str) -> dict:
+    """Exposition text → ``{histograms: [...], utilization: [...]}``.
+
+    Each histogram series becomes p50/p90/p99 estimated from its
+    cumulative buckets (PromQL ``histogram_quantile`` math,
+    ``obs.metrics.quantile_from_buckets``) — one row per label set.
+    """
+    from .obs.metrics import parse_exposition, quantile_from_buckets
+    families = parse_exposition(text)
+
+    hists = []
+    for fname, fam in sorted(families.items()):
+        if fam["type"] != "histogram":
+            continue
+        series: dict[tuple, dict] = {}
+        for name, labels, value in fam["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            s = series.setdefault(key, {"buckets": [], "sum": 0.0,
+                                        "count": 0})
+            if name.endswith("_bucket"):
+                s["buckets"].append((float(labels["le"]), value))
+            elif name.endswith("_sum"):
+                s["sum"] = value
+            elif name.endswith("_count"):
+                s["count"] = int(value)
+        for key, s in sorted(series.items()):
+            bounds = [b for b, _ in sorted(s["buckets"])]
+            cums = [int(c) for _, c in sorted(s["buckets"])]
+            hists.append({
+                "family": fname,
+                "labels": dict(key),
+                "count": s["count"],
+                "sum_s": s["sum"],
+                "p50": quantile_from_buckets(bounds, cums, 0.50),
+                "p90": quantile_from_buckets(bounds, cums, 0.90),
+                "p99": quantile_from_buckets(bounds, cums, 0.99),
+            })
+
+    util = []
+    fam = families.get("kubeshare_token_utilization_ratio")
+    if fam:
+        for _, labels, value in sorted(fam["samples"],
+                                       key=lambda s: sorted(s[1].items())):
+            util.append({"chip": labels.get("chip", "?"),
+                         "client": labels.get("client", "?"),
+                         "ratio": value})
+    return {"histograms": hists, "utilization": util}
+
+
+def render_latency(lat: dict, source: str) -> str:
+    lines = [f"LATENCY ({source})"]
+    rows = lat["histograms"]
+    if not rows:
+        lines.append("  no histogram families in the exposition — nothing "
+                     "has been scheduled/executed since start")
+    else:
+        lines.append(f"  {'family':<42} {'labels':<22} {'count':>6} "
+                     f"{'p50':>8} {'p90':>8} {'p99':>8}")
+        for r in rows:
+            labels = ",".join(f"{k}={v}" for k, v in r["labels"].items())
+            lines.append(
+                f"  {r['family']:<42} {labels:<22} {r['count']:>6} "
+                f"{_fmt_seconds(r['p50']):>8} {_fmt_seconds(r['p90']):>8} "
+                f"{_fmt_seconds(r['p99']):>8}")
+    if lat["utilization"]:
+        lines.append("TOKEN UTILIZATION (window share per chip)")
+        for u in lat["utilization"]:
+            bar = "#" * int(min(max(u["ratio"], 0.0), 1.0) * 20)
+            lines.append(f"  {u['chip']:<20} {u['client']:<20} "
+                         f"{u['ratio']:>6.2f} |{bar:<20}|")
+    return "\n".join(lines)
+
+
+def _fetch_exposition(url: str, timeout: float = 5.0) -> str:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
 def _opportunistic(priority: str) -> bool:
     """Match the scheduler's rule: priority <= 0 is opportunistic
     (``scheduler/labels.py``), not just the literal "0"."""
@@ -153,6 +248,10 @@ def main(argv=None) -> int:
                         help="refresh every N seconds (0 = one shot)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable snapshot instead of a table")
+    parser.add_argument("--latency", action="store_true",
+                        help="phase-latency percentiles + per-chip token "
+                             "utilization from /metrics instead of the "
+                             "fleet table")
     args = parser.parse_args(argv)
     host, _, port = args.registry.rpartition(":")
     client = RegistryClient(host or "127.0.0.1", int(port))
@@ -164,15 +263,33 @@ def main(argv=None) -> int:
         # advisory call: a hung scheduler must not stall --watch frames
         scheduler = ServiceClient(base, timeout=3.0)
 
+    # --latency scrapes the scheduler when one is named (its exposition
+    # embeds the process-wide obs registry), else the telemetry registry
+    metrics_url = ""
+    if args.latency:
+        if args.scheduler:
+            base = (args.scheduler if "://" in args.scheduler
+                    else "http://" + args.scheduler)
+            metrics_url = base.rstrip("/") + "/metrics"
+        else:
+            host_part = host or "127.0.0.1"
+            metrics_url = f"http://{host_part}:{port}/metrics"
+
     try:
         while True:
             try:
-                snap = snapshot(client, args.node, scheduler)
+                if args.latency:
+                    lat = latency_snapshot(_fetch_exposition(metrics_url))
+                    out = (json.dumps(lat) if args.json
+                           else render_latency(lat, metrics_url))
+                else:
+                    snap = snapshot(client, args.node, scheduler)
+                    out = json.dumps(snap) if args.json else render(snap)
             except (urllib.error.URLError, OSError, ValueError) as exc:
-                print(f"kubeshare-top: registry {args.registry} "
+                target = metrics_url if args.latency else args.registry
+                print(f"kubeshare-top: {target} "
                       f"unreachable: {exc}", file=sys.stderr)
                 return 2
-            out = json.dumps(snap) if args.json else render(snap)
             if args.watch > 0:
                 if args.json:
                     print(out, flush=True)  # one parseable frame per line

@@ -1,0 +1,323 @@
+"""Observability plane: metric primitives, the strict exposition
+renderer/linter, tracer export formats, and the RegistryClient
+retry-with-backoff (counted through the obs counters)."""
+
+import json
+import math
+import urllib.error
+
+import pytest
+
+from kubeshare_tpu.obs import metrics as m
+from kubeshare_tpu.obs.trace import (Tracer, get_tracer, install_tracer,
+                                     new_trace_id, tracing_enabled,
+                                     uninstall_tracer)
+from kubeshare_tpu.telemetry.registry import RegistryClient, _RETRIES
+
+
+# -- escaping + line grammar -------------------------------------------------
+
+def test_prom_escape_specials():
+    assert m.prom_escape('a\\b') == 'a\\\\b'
+    assert m.prom_escape('say "hi"') == 'say \\"hi\\"'
+    assert m.prom_escape('line1\nline2') == 'line1\\nline2'
+    # all three at once, round-trippable through the parser
+    nasty = 'p\\q"r\ns'
+    line = m.render_sample('fam', {'k': nasty}, 1)
+    fams = m.parse_exposition(line)
+    assert fams['fam']['samples'] == [('fam', {'k': nasty}, 1.0)]
+
+
+def test_render_sample_shapes():
+    assert m.render_sample('f', None, 3) == 'f 3'
+    assert m.render_sample('f', {}, 3) == 'f 3'
+    assert m.render_sample('f', {'b': '1', 'a': '2'}, 0.5) == \
+        'f{a="2",b="1"} 0.5'
+    assert m.render_sample('f', {'le': '+Inf'}, math.inf) == \
+        'f{le="+Inf"} +Inf'
+
+
+def test_help_type_headers():
+    lines = m.render_help_type('f', 'counter', 'does things')
+    assert lines == ['# HELP f does things', '# TYPE f counter']
+
+
+# -- primitives --------------------------------------------------------------
+
+def test_counter_inc_and_negative_rejected():
+    reg = m.MetricsRegistry()
+    c = reg.counter('hits_total', 'hits', labels=('op',))
+    c.inc('get')
+    c.inc('get', amount=2)
+    assert c.value('get') == 3
+    assert c.value('put') == 0
+    with pytest.raises(ValueError):
+        c.inc('get', amount=-1)
+    # label arity is enforced
+    with pytest.raises(ValueError):
+        c.inc('get', 'extra')
+
+
+def test_gauge_set_inc():
+    reg = m.MetricsRegistry()
+    g = reg.gauge('depth', 'queue depth')
+    g.set(value=5)
+    g.inc(amount=-2)
+    assert g.value() == 3
+
+
+def test_histogram_cumulative_buckets_and_quantiles():
+    reg = m.MetricsRegistry()
+    h = reg.histogram('lat', 'latency', buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.05, 0.5, 2.0):
+        h.observe(value=v)
+    cumulative, total, count = h.snapshot()
+    assert cumulative == [2, 3, 4, 4]          # +Inf appended
+    assert count == 4 and total == pytest.approx(2.6)
+    p50 = m.quantile_from_buckets(h.buckets, cumulative, 0.5)
+    assert 0.0 < p50 <= 0.1
+    p99 = m.quantile_from_buckets(h.buckets, cumulative, 0.99)
+    assert 1.0 < p99 <= 10.0
+
+
+def test_quantile_edge_cases():
+    assert math.isnan(m.quantile_from_buckets((1.0, math.inf), (0, 0), 0.5))
+    # everything lands in +Inf: clamp to the previous finite bound
+    assert m.quantile_from_buckets((1.0, math.inf), (0, 5), 0.99) == 1.0
+
+
+def test_registry_idempotent_getter_and_type_conflict():
+    reg = m.MetricsRegistry()
+    a = reg.counter('x_total', 'x')
+    assert reg.counter('x_total', 'ignored') is a
+    with pytest.raises(ValueError):
+        reg.gauge('x_total', 'now a gauge')
+    with pytest.raises(ValueError):
+        reg.counter('bad name', 'spaces')
+
+
+# -- exposition render → lint round trip -------------------------------------
+
+def test_full_render_passes_lint():
+    reg = m.MetricsRegistry()
+    reg.counter('req_total', 'requests', labels=('op',)).inc('GET /pods')
+    reg.gauge('util', 'share', labels=('chip', 'client')).set(
+        'chip0', 'ns/pod "a"\nx', value=0.25)
+    reg.histogram('lat_seconds', 'latency', labels=('phase',)).observe(
+        'filter', value=0.003)
+    text = reg.render()
+    assert m.lint_exposition(text) == []
+    fams = m.parse_exposition(text)
+    assert fams['req_total']['type'] == 'counter'
+    assert fams['lat_seconds']['type'] == 'histogram'
+    # histogram sub-samples attach to the base family
+    names = {s[0] for s in fams['lat_seconds']['samples']}
+    assert names == {'lat_seconds_bucket', 'lat_seconds_sum',
+                     'lat_seconds_count'}
+    # the nasty label value survived the round trip
+    (_, labels, value), = fams['util']['samples']
+    assert labels == {'chip': 'chip0', 'client': 'ns/pod "a"\nx'}
+    assert value == 0.25
+
+
+def test_lint_flags_missing_headers_and_bad_lines():
+    assert m.lint_exposition('# TYPE f counter\nf 1\n') == \
+        ['family f has samples but no # HELP']
+    assert m.lint_exposition('# HELP f h\nf 1\n') == \
+        ['family f has samples but no # TYPE']
+    errs = m.lint_exposition('this is not { exposition\n')
+    assert len(errs) == 1 and 'malformed' in errs[0]
+    # headers without samples are fine (declared but never observed)
+    assert m.lint_exposition('# HELP f h\n# TYPE f counter\n') == []
+
+
+def test_live_endpoints_lint_clean():
+    """Both /metrics renderers (registry service + scheduler service) go
+    through the one shared exposition path and must lint clean with obs
+    families populated."""
+    from kubeshare_tpu.telemetry.registry import TelemetryRegistry
+    m.default_registry().histogram(
+        'kubeshare_sched_phase_latency_seconds',
+        'Scheduler engine phase latency.', labels=('phase',)
+    ).observe('filter', value=0.001)
+    reg = TelemetryRegistry()
+    reg.put_capacity('n0', [{'chip_id': 'c0', 'model': 'v4'}])
+    text = reg.render_metrics()
+    assert m.lint_exposition(text) == []
+    assert 'kubeshare_sched_phase_latency_seconds_bucket' in text
+    assert '# TYPE tpu_capacity gauge' in text
+
+
+# -- RegistryClient retry-with-backoff ---------------------------------------
+
+class _FakeResponse:
+    def __init__(self, payload: bytes):
+        self._payload = payload
+
+    def read(self):
+        return self._payload
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _no_sleep_client():
+    client = RegistryClient('127.0.0.1', 1)
+    client.RETRY_BACKOFF_S = 0.0
+    return client
+
+
+def test_client_retries_transient_then_succeeds():
+    client = _no_sleep_client()
+    calls = []
+
+    def flaky(req, timeout=None):
+        calls.append(req.selector)
+        if len(calls) < 3:
+            raise urllib.error.URLError('connection refused')
+        return _FakeResponse(b'{"a": 1}')
+
+    client._open = flaky
+    before = _RETRIES.value('GET /pods')
+    assert client.pods() == {'a': 1}
+    assert len(calls) == 3
+    assert _RETRIES.value('GET /pods') - before == 2
+
+
+def test_client_gives_up_after_attempts():
+    client = _no_sleep_client()
+    calls = []
+
+    def dead(req, timeout=None):
+        calls.append(1)
+        raise urllib.error.URLError('still down')
+
+    client._open = dead
+    with pytest.raises(urllib.error.URLError):
+        client.capacity()
+    assert len(calls) == client.RETRY_ATTEMPTS
+
+
+def test_client_http_error_not_retried():
+    client = _no_sleep_client()
+    calls = []
+
+    def answered(req, timeout=None):
+        calls.append(1)
+        raise urllib.error.HTTPError(req.full_url, 404, 'nope', {}, None)
+
+    client._open = answered
+    before = _RETRIES.value('GET /capacity')
+    with pytest.raises(urllib.error.HTTPError):
+        client.capacity()
+    assert len(calls) == 1                       # the registry answered
+    assert _RETRIES.value('GET /capacity') == before
+
+
+# -- tracer ------------------------------------------------------------------
+
+def test_tracer_span_lifecycle_and_export(tmp_path):
+    tracer = Tracer()
+    tid = new_trace_id()
+    root = tracer.begin('submit', tid, pod='ns/p')
+    with tracer.span('filter', tid, root.span_id) as s:
+        s.attrs['candidates'] = 4
+    tracer.record('queue-wait', tid, root.start_ms, tracer.now_ms(),
+                  root.span_id)
+    tracer.finish(root)
+
+    spans = tracer.spans(tid)
+    assert [s.name for s in spans] == ['submit', 'filter', 'queue-wait']
+    assert all(s.trace_id == tid for s in spans)
+    assert spans[1].parent_id == root.span_id
+    assert spans[1].duration_ms is not None and spans[1].duration_ms >= 0
+
+    out = tmp_path / 'trace.jsonl'
+    assert tracer.export_jsonl(out, tid) == 3
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(rows) == 3
+    assert {r['trace_id'] for r in rows} == {tid}
+    # sorted by start time, every row closed
+    starts = [r['start_ms'] for r in rows]
+    assert starts == sorted(starts)
+    assert all(r['end_ms'] is not None for r in rows)
+
+
+def test_tracer_open_root_closed_at_export():
+    tracer = Tracer()
+    tid = new_trace_id()
+    root = tracer.begin('submit', tid)
+    with tracer.span('filter', tid, root.span_id):
+        pass
+    # root still open in memory, closed (flagged) in the export
+    chrome = tracer.chrome_trace(tid)
+    events = [e for e in chrome['traceEvents'] if e['ph'] == 'X']
+    by_name = {e['name']: e for e in events}
+    assert by_name['submit']['args'].get('open') is True
+    sub = by_name['submit']
+    fil = by_name['filter']
+    assert sub['ts'] <= fil['ts'] + 0.5   # 0.1 µs export rounding slack
+    assert fil['ts'] + fil['dur'] <= sub['ts'] + sub['dur'] + 0.5
+
+
+def test_chrome_trace_shape():
+    tracer = Tracer()
+    t1, t2 = new_trace_id(), new_trace_id()
+    tracer.finish(tracer.begin('a', t1))
+    tracer.finish(tracer.begin('b', t2))
+    doc = tracer.chrome_trace()
+    json.dumps(doc)                       # must be JSON-serializable
+    assert doc['displayTimeUnit'] == 'ms'
+    events = doc['traceEvents']
+    assert {e['ph'] for e in events} == {'M', 'X'}
+    # one pid per trace, with a process_name metadata row each
+    xpids = {e['pid'] for e in events if e['ph'] == 'X'}
+    mpids = {e['pid'] for e in events if e['ph'] == 'M'}
+    assert len(xpids) == 2 and xpids == mpids
+
+
+def test_tracer_capacity_bounded():
+    tracer = Tracer(capacity=5)
+    tid = new_trace_id()
+    for i in range(20):
+        tracer.finish(tracer.begin(f's{i}', tid))
+    assert len(tracer.spans()) == 5
+    assert tracer.spans()[-1].name == 's19'
+
+
+def test_runner_step_timer_records_histogram_and_spans():
+    from kubeshare_tpu.parallel import runner
+    hist = m.default_registry().get('kubeshare_runner_step_seconds')
+    _, _, before = hist.snapshot('train')
+    tracer = install_tracer(Tracer())
+    try:
+        tid = new_trace_id()
+        for step in runner.timed_range(3, trace_id=tid):
+            assert step in (0, 1, 2)
+        with runner.step_timer('eval'):
+            pass
+    finally:
+        uninstall_tracer()
+    _, _, after = hist.snapshot('train')
+    assert after - before == 3
+    _, _, evals = hist.snapshot('eval')
+    assert evals >= 1
+    steps = [s for s in tracer.spans(tid) if s.name == 'step']
+    assert [s.attrs['step'] for s in steps] == [0, 1, 2]
+    assert all(s.end_ms is not None for s in steps)
+
+
+def test_install_uninstall_null_tracer():
+    assert not tracing_enabled()
+    null = get_tracer()
+    null.finish(null.begin('x', new_trace_id()))
+    assert null.spans() == []             # null tracer records nothing
+    tracer = install_tracer()
+    try:
+        assert tracing_enabled() and get_tracer() is tracer
+    finally:
+        uninstall_tracer()
+    assert not tracing_enabled()

@@ -67,6 +67,38 @@ def test_cli_unreachable_registry_exits_2(capsys):
     assert "unreachable" in capsys.readouterr().err
 
 
+def test_cli_latency_view(capsys):
+    from kubeshare_tpu.obs import metrics as m
+    m.default_registry().histogram(
+        "kubeshare_sched_phase_latency_seconds",
+        "Scheduler engine phase latency.",
+        labels=("phase",)).observe("filter", value=0.002)
+    m.default_registry().gauge(
+        "kubeshare_token_utilization_ratio",
+        "Client share of the token window.",
+        labels=("chip", "client")).set("chip0", "ns/a", value=0.4)
+    reg, srv, _ = serve_fleet()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        assert topcli.main(["--registry", addr, "--latency"]) == 0
+        out = capsys.readouterr().out
+        assert "kubeshare_sched_phase_latency_seconds" in out
+        assert "phase=filter" in out and "p99" in out
+        assert "TOKEN UTILIZATION" in out and "chip0" in out
+
+        assert topcli.main(["--registry", addr, "--latency",
+                            "--json"]) == 0
+        lat = json.loads(capsys.readouterr().out)
+        row = next(h for h in lat["histograms"]
+                   if h["family"] == "kubeshare_sched_phase_latency_seconds"
+                   and h["labels"] == {"phase": "filter"})
+        assert row["count"] >= 1 and 0 < row["p50"] <= 0.0025
+        assert {"chip": "chip0", "client": "ns/a", "ratio": 0.4} in \
+            lat["utilization"]
+    finally:
+        srv.shutdown()
+
+
 def test_cli_annotates_outstanding_evictions(capsys):
     """--scheduler surfaces the dispatcher's preemption plans: victims
     render EVICTING with their preemptor."""

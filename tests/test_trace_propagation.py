@@ -1,0 +1,166 @@
+"""One pod's trace stitches across every layer.
+
+The acceptance bar for the observability plane: a simulated pod pushed
+through engine + dispatcher + isolation produces spans that all share
+the trace ID minted at ``SchedulerEngine.submit``, with the root
+``submit`` span containing queue-wait, filter, reserve, bind, and the
+server-side token-grant (carried over TCP via the ``_trace`` message
+key — ``isolation/protocol.py``). Gate mode keeps this test jax-free:
+``ExecutionGate.connect`` dials a real ``tokensched.serve`` server.
+"""
+
+import json
+
+import pytest
+
+from kubeshare_tpu import constants as C
+from kubeshare_tpu.isolation import protocol, tokensched
+from kubeshare_tpu.isolation.client import ExecutionGate
+from kubeshare_tpu.isolation.tokensched import TokenScheduler
+from kubeshare_tpu.obs.trace import Tracer, install_tracer, uninstall_tracer
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+REQUIRED = {"submit", "queue-wait", "filter", "reserve", "bind",
+            "token-grant"}
+
+
+@pytest.fixture
+def tracer():
+    t = install_tracer(Tracer())
+    yield t
+    uninstall_tracer()
+
+
+def make_engine():
+    eng = SchedulerEngine()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=1, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    return eng
+
+
+def shared_labels(request="0.5", limit="1.0"):
+    return {C.POD_TPU_REQUEST: request, C.POD_TPU_LIMIT: limit}
+
+
+def run_pod_through_stack(tracer, name="p"):
+    """submit → dispatch/bind → token gate over TCP; returns trace_id."""
+    eng = make_engine()
+    disp = Dispatcher(eng, TelemetryRegistry())
+    key = disp.submit("ns", name, shared_labels())
+    disp.step()
+    assert disp.outcome(key).status == "bound"
+    trace_id = eng.pod_status[key].trace_id
+    assert trace_id
+
+    sched = TokenScheduler(window_ms=1000.0, base_quota_ms=100.0,
+                           min_quota_ms=10.0, chip="chip0")
+    server = tokensched.serve(sched)
+    try:
+        gate = ExecutionGate.connect(
+            "127.0.0.1", server.server_address[1], key,
+            request=0.5, limit=1.0, trace_id=trace_id)
+        gate()                      # acquire — server records token-grant
+        gate.close()
+    finally:
+        server.shutdown()
+    return trace_id
+
+
+def test_single_pod_trace_stitches_all_layers(tracer):
+    trace_id = run_pod_through_stack(tracer)
+
+    spans = tracer.spans(trace_id)
+    assert len(spans) >= 6
+    names = {s.name for s in spans}
+    assert REQUIRED <= names, f"missing {REQUIRED - names}"
+    # every span of the pod's run carries the one trace ID — nothing
+    # leaked onto a different or empty ID
+    strays = [s for s in tracer.spans() if s.trace_id != trace_id]
+    assert not strays, [s.name for s in strays]
+
+
+def test_submit_contains_children_in_export(tracer):
+    trace_id = run_pod_through_stack(tracer)
+
+    # containment must hold in the EXPORTED (closed) view, where the
+    # still-open submit root is closed at the trace's last end time
+    doc = tracer.chrome_trace(trace_id)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_name: dict = {}
+    for e in events:
+        by_name.setdefault(e["name"], e)
+    sub = by_name["submit"]
+    # ts and dur are independently rounded to 0.1 µs on export, so the
+    # containment comparison carries up to ~0.2 µs of rounding slack
+    eps = 0.5
+    for child in REQUIRED - {"submit"}:
+        e = by_name[child]
+        assert sub["ts"] <= e["ts"] + eps, f"{child} starts before submit"
+        assert e["ts"] + e["dur"] <= sub["ts"] + sub["dur"] + eps, \
+            f"{child} ends after submit"
+        assert e["args"]["trace_id"] == trace_id
+
+
+def test_chrome_export_is_valid_trace_event_json(tracer, tmp_path):
+    trace_id = run_pod_through_stack(tracer)
+
+    doc = tracer.chrome_trace(trace_id)
+    text = json.dumps(doc)                    # serializable
+    loaded = json.loads(text)
+    assert loaded["displayTimeUnit"] == "ms"
+    xs = [e for e in loaded["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) >= 6
+    for e in xs:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert e["pid"] == 1 and e["tid"] == 1
+    metas = [e for e in loaded["traceEvents"] if e["ph"] == "M"]
+    assert metas and metas[0]["args"]["name"].startswith("trace ")
+
+    out = tmp_path / "pod.jsonl"
+    n = tracer.export_jsonl(out, trace_id)
+    assert n == len(xs)
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert {r["trace_id"] for r in rows} == {trace_id}
+    assert all(r["end_ms"] is not None for r in rows)
+
+
+def test_trace_key_sticky_per_connection(tracer):
+    """The ``_trace`` key needs to ride only the FIRST message — the
+    server pins it to the connection state, so later ops (acquire sent
+    without an explicit key by ExecutionGate's conn) still land spans on
+    the pod's trace."""
+    sched = TokenScheduler(window_ms=1000.0, base_quota_ms=100.0,
+                           min_quota_ms=10.0, chip="chipZ")
+    server = tokensched.serve(sched)
+    try:
+        with protocol.Connection("127.0.0.1", server.server_address[1],
+                                 trace_id="tid-sticky") as conn:
+            conn.call({"op": "register", "name": "p", "request": 0.5,
+                       "limit": 1.0})
+            conn.call({"op": "acquire", "name": "p"})
+    finally:
+        server.shutdown()
+    grants = [s for s in tracer.spans("tid-sticky")
+              if s.name == "token-grant"]
+    assert len(grants) == 1
+    assert grants[0].attrs["chip"] == "chipZ"
+    assert grants[0].attrs["client"] == "p"
+
+
+def test_no_tracing_no_spans_no_crash():
+    """Everything runs identically with the null tracer installed —
+    instrumentation must be invisible when not opted in."""
+    eng = make_engine()
+    disp = Dispatcher(eng, TelemetryRegistry())
+    key = disp.submit("ns", "quiet", shared_labels())
+    disp.step()
+    assert disp.outcome(key).status == "bound"
+    from kubeshare_tpu.obs.trace import get_tracer
+    assert get_tracer().spans() == []
