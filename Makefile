@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench image clean obs-check
+.PHONY: all native test bench bench-proxy image clean obs-check
 
 all: native
 
@@ -39,6 +39,12 @@ obs-check:
 
 bench:
 	$(PY) bench.py
+
+# Transport micro-bench (doc/isolation-wire.md): prints fresh numbers,
+# deltas vs the committed baseline, and refreshes bench_proxy.json.
+bench-proxy:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_proxy.py \
+		--baseline bench_proxy.json --write bench_proxy.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -21,15 +21,23 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..utils.logger import get_logger
 from . import protocol
 from .protocol import load_array
 
 log = get_logger("client")
+
+_WINDOW_STALLS = obs_metrics.default_registry().counter(
+    "kubeshare_client_window_stalls_total",
+    "Times a windowed put/get stream had to block on its oldest in-flight "
+    "chunk before submitting the next (transfer credit exhausted — the "
+    "wire or the peer is the bottleneck, not this client).", labels=("op",))
 
 
 def _real_jit():
@@ -57,6 +65,47 @@ class RemoteBuffer:
         return n * np.dtype(self.dtype).itemsize
 
 
+class RemoteFuture:
+    """A not-yet-resolved result of an async proxy dispatch
+    (:meth:`ProxyClient.execute_async` / ``call_async``).
+
+    ``result()`` blocks until the reply arrives, raises the remote error
+    if the op failed, and maps the reply exactly once (subsequent calls
+    return/raise the cached outcome). On a lockstep (un-pipelined)
+    connection the dispatch already completed synchronously and
+    ``result()`` just unwraps it — caller code is mode-agnostic.
+    """
+
+    __slots__ = ("_resolve", "_pending", "_mu", "_done", "_value", "_exc")
+
+    def __init__(self, resolve, pending: "protocol.PendingReply | None" = None):
+        self._resolve = resolve        # () -> value; blocks, may raise
+        self._pending = pending
+        self._mu = threading.Lock()
+        self._done = False
+        self._value = None
+        self._exc: Exception | None = None
+
+    def done(self) -> bool:
+        with self._mu:
+            if self._done:
+                return True
+        return self._pending is None or self._pending.done()
+
+    def result(self):
+        with self._mu:
+            if not self._done:
+                try:
+                    self._value = self._resolve()
+                except Exception as e:
+                    self._exc = e
+                self._done = True
+                self._resolve = None   # drop captured state
+            if self._exc is not None:
+                raise self._exc
+            return self._value
+
+
 class RemoteExecutable:
     """A compiled program on the proxy; call with pytrees of
     :class:`RemoteBuffer` (or host arrays, which are uploaded per call)."""
@@ -70,6 +119,14 @@ class RemoteExecutable:
         self.out_meta = out_meta
 
     def __call__(self, *args, donate: bool = False):
+        return self.call_async(*args, donate=donate).result()
+
+    def call_async(self, *args, donate: bool = False) -> RemoteFuture:
+        """Dispatch without waiting for completion: uploads happen now
+        (synchronously), the execute itself rides the pipelined
+        connection, and the returned :class:`RemoteFuture` resolves to
+        the output pytree — so call sites overlap dispatch with host
+        work (and with further dispatches)."""
         import jax
         leaves = jax.tree_util.tree_leaves(args)
         bufs, uploaded = [], []
@@ -80,29 +137,43 @@ class RemoteExecutable:
         # after success, so the failure path never double-frees; the
         # failure-path free is best-effort (the failure may have been the
         # connection itself dying — the original error must win).
+        client = self._client
         try:
             for leaf in leaves:
                 if isinstance(leaf, RemoteBuffer):
                     bufs.append(leaf)
                 else:
-                    buf = self._client.put(leaf)
+                    buf = client.put(leaf)
                     bufs.append(buf)
                     uploaded.append(buf)
-            handles = self._client._execute(
+            fut = client.execute_async(
                 self._exec_id, [b.handle for b in bufs],
                 donate=[b.handle for b in bufs] if donate else ())
         except Exception:
             if uploaded:
                 try:
-                    self._client.free(*uploaded)
+                    client.free(*uploaded)
                 except Exception:
                     pass
             raise
-        if not donate and uploaded:
-            self._client.free(*uploaded)
-        out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
-                    for h, (shape, dtype) in zip(handles, self.out_meta)]
-        return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
+
+        def resolve():
+            try:
+                handles = fut.result()
+            except Exception:
+                if uploaded:
+                    try:
+                        client.free(*uploaded)
+                    except Exception:
+                        pass
+                raise
+            if not donate and uploaded:
+                client.free(*uploaded)
+            out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
+                        for h, (shape, dtype) in zip(handles, self.out_meta)]
+            return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
+
+        return RemoteFuture(resolve, fut._pending)
 
 
 class RemoteLoop:
@@ -129,7 +200,15 @@ class RemoteLoop:
         self.last_burst = 0
 
     def __call__(self, n: int, carry, *consts):
-        return self._dispatch(int(n), carry, consts, chain=False)
+        return self._dispatch_async(int(n), carry, consts,
+                                    chain=False).result()
+
+    def call_async(self, n: int, carry, *consts) -> "RemoteFuture":
+        """Dispatch a fused burst without waiting: the future resolves to
+        the ``(new_carry, aux)`` tree. ``last_n``/``last_burst`` update
+        when the future RESOLVES (the clamp is in the reply), so read
+        them after ``result()``."""
+        return self._dispatch_async(int(n), carry, consts, chain=False)
 
     def chain(self, n: int, carry, *consts):
         """Run toward ``n`` iterations with SERVER-SIDE burst chaining:
@@ -139,9 +218,11 @@ class RemoteLoop:
         stop early (bounded bursts per call) — ``last_n`` reports the
         steps actually run; call again for the remainder. Fairness is
         unchanged: every burst passes the token gate individually."""
-        return self._dispatch(int(n), carry, consts, chain=True)
+        return self._dispatch_async(int(n), carry, consts,
+                                    chain=True).result()
 
-    def _dispatch(self, n: int, carry, consts, chain: bool):
+    def _dispatch_async(self, n: int, carry, consts,
+                        chain: bool) -> "RemoteFuture":
         import jax
         if n < 1:
             # Clamping 0 → 1 would silently apply an extra step to the
@@ -153,13 +234,18 @@ class RemoteLoop:
             raise TypeError("RemoteLoop args must be device-resident "
                             "(put them first)")
         carry_handles = [b.handle for b in leaves[:self._ncarry]]
-        handles, self.last_n, self.last_burst = self._client._execute_n(
+        fut = self._client._execute_n_async(
             self._exec_id, [b.handle for b in leaves],
             donate=carry_handles,
             **({"chain_steps": n} if chain else {"repeat": n}))
-        out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
-                    for h, (shape, dtype) in zip(handles, self.out_meta)]
-        return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
+
+        def resolve():
+            handles, self.last_n, self.last_burst = fut.result()
+            out_bufs = [RemoteBuffer(h, tuple(shape), dtype)
+                        for h, (shape, dtype) in zip(handles, self.out_meta)]
+            return jax.tree_util.tree_unflatten(self._out_tree, out_bufs)
+
+        return RemoteFuture(resolve, fut._pending)
 
 
 class ProxyClient:
@@ -177,9 +263,17 @@ class ProxyClient:
                                          trace_id=trace_id)
         reply, _ = self._conn.call({
             "op": "register", "name": name, "request": request,
-            "limit": limit, "memory": memory})
+            "limit": limit, "memory": memory,
+            # feature negotiation: ask for the pipelined transport; an old
+            # proxy simply ignores the key and omits it from the reply,
+            # leaving this client in lockstep mode
+            "features": list(protocol.FEATURES)})
         self.platforms: list[str] = reply["platforms"]
         self.device: str = reply.get("device", "")
+        #: transport features BOTH ends agreed on at register
+        self.features: frozenset[str] = frozenset(reply.get("features", ()))
+        if "seq" in self.features:
+            self._conn.start_pipeline()
 
     # -- buffers -------------------------------------------------------------
 
@@ -189,62 +283,158 @@ class ProxyClient:
         # sliced path; deployments may lower it for memory hygiene).
         return max(1, min(self.chunk_bytes, protocol.MAX_FRAME - 4096))
 
+    @staticmethod
+    def _window(chunk: int) -> int:
+        """Chunks of transfer credit in flight for windowed put/get:
+        enough to keep the wire busy across the reply RTT, but never more
+        than ~256 MiB of payload outstanding (the peer buffers in-flight
+        chunks; see SERVER_CREDIT for its own bound)."""
+        return max(2, min(16, (256 << 20) // max(chunk, 1)))
+
     def put(self, array) -> RemoteBuffer:
         arr = np.asarray(array)
         # parts = [npy header, flat data view]: the payload crosses the
         # socket straight from the array's memory — zero host copies on
         # this side (protocol.dump_array_parts)
         parts = protocol.dump_array_parts(arr)
-        nbytes = sum(memoryview(p).nbytes for p in parts)
+        nbytes = protocol.buffers_nbytes(parts)
         chunk = self._chunk()
         if nbytes <= chunk:
             reply, _ = self._conn.call({"op": "put", "name": self.name},
                                        blob=parts)
         else:
-            reply0, _ = self._conn.call({"op": "put_begin",
-                                         "name": self.name,
-                                         "nbytes": nbytes})
-            sid = reply0["staging"]
-            try:
-                for off in range(0, nbytes, chunk):
-                    self._conn.call(
-                        {"op": "put_chunk", "name": self.name,
-                         "staging": sid, "offset": off},
-                        blob=protocol.slice_buffers(parts, off, chunk))
-                reply, _ = self._conn.call({"op": "put_commit",
-                                            "name": self.name,
-                                            "staging": sid})
-            except RuntimeError:
-                # Remote-side refusal (HBM cap, bad chunk): drop the staged
-                # bytes; the connection itself is still in sync.
-                self._conn.call({"op": "put_abort", "name": self.name,
-                                 "staging": sid})
-                raise
+            reply = self._put_chunked(parts, nbytes, chunk)
         return RemoteBuffer(reply["handle"], tuple(reply["shape"]),
                             reply["dtype"])
 
+    def _put_chunked(self, parts: list, nbytes: int, chunk: int) -> dict:
+        """Staged upload. Pipelined connections stream a WINDOW of chunks
+        before the first ack (each landing straight in the proxy's staging
+        buffer via its reader-side sink); lockstep connections keep the
+        one-chunk-per-RTT loop. Either way the HBM cap was reserved at
+        put_begin, so refusal happens before the stream moves."""
+        conn = self._conn
+        reply0, _ = conn.call({"op": "put_begin", "name": self.name,
+                               "nbytes": nbytes})
+        sid = reply0["staging"]
+        pending: deque = deque()
+        try:
+            if conn.pipelined:
+                window = self._window(chunk)
+                for off in range(0, nbytes, chunk):
+                    if len(pending) >= window:
+                        head = pending.popleft()
+                        if not head.done():
+                            _WINDOW_STALLS.inc("put")
+                        head.result()
+                    pending.append(conn.submit(
+                        {"op": "put_chunk", "name": self.name,
+                         "staging": sid, "offset": off},
+                        blob=protocol.slice_buffers(parts, off, chunk)))
+                while pending:
+                    pending.popleft().result()
+            else:
+                for off in range(0, nbytes, chunk):
+                    conn.call(
+                        {"op": "put_chunk", "name": self.name,
+                         "staging": sid, "offset": off},
+                        blob=protocol.slice_buffers(parts, off, chunk))
+            reply, _ = conn.call({"op": "put_commit", "name": self.name,
+                                  "staging": sid})
+            return reply
+        except RuntimeError:
+            # Remote-side refusal (HBM cap, bad chunk): drain any
+            # remaining window credit (later chunks may have failed too —
+            # immaterial now), then drop the staged bytes; the connection
+            # itself is still in sync. put_abort works mid-window because
+            # the server handles strictly in arrival order.
+            while pending:
+                try:
+                    pending.popleft().result()
+                except Exception:
+                    pass
+            try:
+                conn.call({"op": "put_abort", "name": self.name,
+                           "staging": sid})
+            except Exception:
+                pass
+            raise
+
     def get(self, buf: RemoteBuffer) -> np.ndarray:
         chunk = self._chunk()
-        reply, blob = self._conn.call({"op": "get", "name": self.name,
-                                       "handle": buf.handle,
-                                       "offset": 0, "length": chunk})
-        assert blob is not None
+        conn = self._conn
+        # The serialized stream is the buffer's bytes plus a <4 KiB .npy
+        # header, so its length is known within slack BEFORE the first
+        # reply: preallocate the reassembly buffer and receive every
+        # chunk — the first included — directly into it (protocol sink),
+        # eliminating both client-side copies of the old path.
+        est = int(buf.nbytes) + 4096
+        raw = bytearray(est)
+        mv = memoryview(raw)
+        n0 = min(chunk, est)
+        reply, part = conn.call({"op": "get", "name": self.name,
+                                 "handle": buf.handle,
+                                 "offset": 0, "length": n0},
+                                sink=mv[:n0])
+        assert part is not None
         total = int(reply["total"])
-        if len(blob) >= total:
-            return load_array(blob)
-        raw = bytearray(total)
-        raw[:len(blob)] = blob
-        off = len(blob)
-        while off < total:
-            _, part = self._conn.call({"op": "get", "name": self.name,
-                                       "handle": buf.handle,
-                                       "offset": off, "length": chunk})
-            assert part
-            raw[off:off + len(part)] = part
-            off += len(part)
+        if total > est:  # header beyond the 4 KiB allowance — never in
+            # practice, but never corrupt data over it: restart exact-sized
+            raw2 = bytearray(total)
+            mv2 = memoryview(raw2)
+            mv2[:len(part)] = part
+            raw, mv = raw2, mv2
+        got = len(part)
+        if not (isinstance(part, memoryview) and part.obj is raw):
+            # reader fell back to a scratch buffer (sink size mismatch)
+            mv[:got] = part
+        if got < total:
+            if conn.pipelined:
+                self._get_windowed(buf, mv, got, total, chunk)
+            else:
+                off = got
+                while off < total:
+                    length = min(chunk, total - off)
+                    _, part = conn.call(
+                        {"op": "get", "name": self.name,
+                         "handle": buf.handle, "offset": off,
+                         "length": length}, sink=mv[off:off + length])
+                    assert part is not None and len(part) > 0
+                    if not (isinstance(part, memoryview)
+                            and part.obj is raw):
+                        mv[off:off + len(part)] = part
+                    off += len(part)
         # zero-copy: the array views the reassembly buffer (mutable, so
-        # the user-facing result stays writable without a copy)
-        return load_array(raw)
+        # the user-facing result stays writable without a copy); the view
+        # is length-exact — trailing slack must not reach np.frombuffer
+        return load_array(mv[:total])
+
+    def _get_windowed(self, buf: RemoteBuffer, mv: memoryview, start: int,
+                      total: int, chunk: int) -> None:
+        """Pipelined tail of a sliced download: keep a window of slice
+        requests in flight, each reply landing straight in its offset view
+        of the destination. The server returns exactly the requested
+        lengths (offsets are deterministic), so submission order is free
+        of data dependencies."""
+        conn = self._conn
+        window = self._window(chunk)
+        pending: deque = deque()
+        off = start
+        while off < total or pending:
+            while off < total and len(pending) < window:
+                length = min(chunk, total - off)
+                pending.append((off, length, conn.submit(
+                    {"op": "get", "name": self.name, "handle": buf.handle,
+                     "offset": off, "length": length},
+                    sink=mv[off:off + length])))
+                off += length
+            doff, dlen, rep = pending.popleft()
+            if not rep.done():
+                _WINDOW_STALLS.inc("get")
+            _, part = rep.result()
+            assert part is not None and len(part) == dlen
+            if not (isinstance(part, memoryview) and part.obj is mv.obj):
+                mv[doff:doff + dlen] = part
 
     def free(self, *bufs) -> None:
         import jax
@@ -343,18 +533,63 @@ class ProxyClient:
                  donate=(), repeat: int = 1) -> list[int]:
         return self._execute_n(exec_id, handles, donate, repeat)[0]
 
+    def execute_async(self, exec_id: int, handles: list[int],
+                      donate=(), repeat: int = 1,
+                      defer: bool = False) -> "RemoteFuture":
+        """Submit an execute without waiting for its reply; the future
+        resolves to the output handle list. On a pipelined connection
+        many dispatches ride the wire concurrently (the proxy still
+        serializes THIS session's ops in submission order, so handle
+        dependencies between back-to-back dispatches are safe).
+
+        ``defer=True`` corks the request (see ``Connection.submit``):
+        back-to-back small dispatches share one wire write. Call
+        ``flush()`` before blocking on a deferred future."""
+        # built inline (not via _execute_n_async) so the hot dispatch
+        # path wraps ONE future, not a future-of-a-future
+        msg = {"op": "execute", "name": self.name, "exec_id": exec_id,
+               "args": handles}
+        if donate:
+            msg["donate"] = list(donate)
+        if repeat != 1:
+            msg["repeat"] = repeat
+        if self._conn.pipelined:
+            rep = self._conn.submit(msg, defer=defer)
+            return RemoteFuture(lambda: list(rep.result()[0]["handles"]),
+                                rep)
+        reply, _ = self._conn.call(msg)   # lockstep: resolved already
+        return RemoteFuture(lambda: list(reply["handles"]))
+
+    def flush(self) -> None:
+        """Send any corked (``defer=True``) requests now."""
+        if self._conn.pipelined:
+            self._conn.flush()
+
     def _execute_n(self, exec_id: int, handles: list[int],
                    donate=(), repeat: int = 1,
                    chain_steps: int = 0) -> tuple[list[int], int, int]:
+        return self._execute_n_async(exec_id, handles, donate, repeat,
+                                     chain_steps).result()
+
+    def _execute_n_async(self, exec_id: int, handles: list[int],
+                         donate=(), repeat: int = 1,
+                         chain_steps: int = 0) -> "RemoteFuture":
         msg = {"op": "execute", "name": self.name, "exec_id": exec_id,
                "args": handles, "donate": list(donate)}
         if chain_steps:
             msg["chain_steps"] = chain_steps
         else:
             msg["repeat"] = repeat
-        reply, _ = self._conn.call(msg)
-        n = int(reply.get("repeat", repeat))
-        return list(reply["handles"]), n, int(reply.get("burst", n))
+
+        def unwrap(reply: dict) -> tuple[list[int], int, int]:
+            n = int(reply.get("repeat", repeat))
+            return list(reply["handles"]), n, int(reply.get("burst", n))
+
+        if self._conn.pipelined:
+            rep = self._conn.submit(msg)
+            return RemoteFuture(lambda: unwrap(rep.result()[0]), rep)
+        reply, _ = self._conn.call(msg)   # lockstep: resolved already
+        return RemoteFuture(lambda: unwrap(reply))
 
     def usage(self) -> dict:
         reply, _ = self._conn.call({"op": "usage", "name": self.name})

@@ -35,7 +35,7 @@ import numpy as np
 from ..obs import metrics as obs_metrics
 from ..utils.logger import get_logger
 from . import protocol
-from .protocol import dump_array, load_array
+from .protocol import load_array
 from .tokensched import TokenScheduler
 
 log = get_logger("proxy")
@@ -88,6 +88,15 @@ class _Executable:
     out_meta: list[tuple[list[int], str]]  # (shape, dtype) per output
     prog: _Program                # compiled artifacts + cost, sha-shared
     ncarry: int | None = None     # loop programs: first ncarry args/outs thread
+    # Hot-path precomputations (the execute handler runs per dispatched op
+    # and is the serial stage of the pipelined transport — jax Array
+    # .nbytes/.dtype property chains cost tens of µs per op if consulted
+    # per dispatch instead of once per compile):
+    # (shape tuple, np.dtype) per arg — validated by direct comparison
+    in_meta: list = field(default_factory=list)
+    # completion-barrier pick: (index of smallest non-empty output or -1,
+    # True when that output is big enough to sync via a 1-element slice)
+    sync_out: tuple = (-1, False)
 
 
 @dataclass
@@ -110,10 +119,14 @@ class _Session:
     exec_count: int = 0
     exec_ms_total: float = 0.0
     # Chunked-transfer state (connection-serialized like everything else):
-    # one cached serialized blob for sliced `get`, and in-flight staged
-    # uploads for `put_begin`/`put_chunk`/`put_commit`.
-    fetch_cache: tuple[int, bytes] | None = None
-    staging: dict[int, tuple[int, bytearray]] = field(default_factory=dict)
+    # one cached serialized stream for sliced `get` as
+    # (handle, parts list, total bytes) — parts, not joined bytes, so the
+    # cache costs exactly the one device→host copy — and in-flight staged
+    # uploads for `put_begin`/`put_chunk`/`put_commit` as
+    # (total, buffer, hbm charge reserved at put_begin).
+    fetch_cache: tuple[int, list, int] | None = None
+    staging: dict[int, tuple[int, bytearray, int]] = field(
+        default_factory=dict)
     #: trace ID propagated by the client at register (protocol TRACE_KEY);
     #: handed to the token scheduler so grant-waits join the pod's timeline
     trace_id: str = ""
@@ -217,7 +230,8 @@ class ChipProxy:
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> protocol.FramedServer:
         self._server = protocol.serve_framed(host, port, self._handle_timed,
-                                             self._cleanup)
+                                             self._cleanup,
+                                             sink=self._blob_sink)
         self._watchdog = threading.Thread(target=self._watch_idle, daemon=True,
                                           name="proxy-idle-watchdog")
         self._watchdog.start()
@@ -330,18 +344,24 @@ class ChipProxy:
             try:
                 result = fn()
             finally:
-                wall = _now_ms() - start
+                end = _now_ms()
+                wall = end - start
                 elapsed = (timing.get("exec_ms", wall)
                            if timing is not None else wall)
                 with sess.lock:
                     sess.used_ms += elapsed
                     sess.exec_count += 1
                     sess.exec_ms_total += elapsed
+                    sess.busy = False
+                    sess.last_end_ms = end
             return result
         finally:
-            with sess.lock:
-                sess.busy = False
-                sess.last_end_ms = _now_ms()
+            # only reached with busy still set when the token gate itself
+            # failed (scheduler closed / renew raised) before dispatch
+            if sess.busy:
+                with sess.lock:
+                    sess.busy = False
+                    sess.last_end_ms = _now_ms()
 
     def _watch_idle(self) -> None:
         """Return tokens from clients that stopped executing (one watchdog
@@ -365,6 +385,36 @@ class ChipProxy:
                         pass
 
     # -- protocol ------------------------------------------------------------
+
+    def _blob_sink(self, msg: dict, state: dict, nbytes: int):
+        """Connection-reader hook (see ``protocol.serve_framed``): land
+        ``put_chunk`` payloads straight in the staged buffer, so an upload
+        chunk is copied exactly once on the proxy (kernel→staging) instead
+        of kernel→scratch→staging — and the recv overlaps the worker
+        handling the previous chunk. Any irregularity (unknown session,
+        unknown staging id, out-of-range offset) returns None; the payload
+        then lands in a scratch buffer and the worker raises the proper
+        error with full context."""
+        if msg.get("op") != "put_chunk":
+            return None
+        name = state.get("name")
+        if not name:
+            return None
+        with self._slock:
+            sess = self._sessions.get(name)
+        if sess is None:
+            return None
+        try:
+            entry = sess.staging.get(int(msg.get("staging", -1)))
+            if entry is None:
+                return None
+            total, raw, _charged = entry
+            off = int(msg.get("offset", -1))
+        except (TypeError, ValueError):
+            return None
+        if off < 0 or off + nbytes > total:
+            return None
+        return memoryview(raw)[off:off + nbytes]
 
     def _handle_timed(self, req: dict, state: dict) -> dict:
         op = str(req.get("op"))
@@ -391,8 +441,16 @@ class ChipProxy:
                                   int(req.get("memory", 0)))
             sess.trace_id = state.get("trace_id", "")
             state["name"] = name
-            return {"ok": True, "platforms": [self.platform],
-                    "device": str(self.device)}
+            reply = {"ok": True, "platforms": [self.platform],
+                     "device": str(self.device)}
+            if "features" in req:
+                # Feature negotiation: granted = requested ∩ supported.
+                # The key is echoed ONLY when the client asked — an
+                # un-negotiating (old-protocol) peer gets the reply shape
+                # it has always gotten, byte-for-byte.
+                reply["features"] = protocol.negotiate_features(
+                    req.get("features") or ())
+            return reply
 
         # Identity is connection-bound: a session is only reachable from the
         # connection that registered it (a client must not be able to burn
@@ -415,20 +473,26 @@ class ChipProxy:
             total = int(req["nbytes"])
             if not 0 < total <= (64 << 30):
                 raise ValueError(f"bad staged size {total}")
-            if sess.memory_cap and (
-                    sess.hbm_used + total - 4096 > sess.memory_cap):
-                # The .npy stream is ~nbytes + a <4 KiB header: an upload
-                # that cannot fit under the HBM cap should be refused here,
-                # not after the client has streamed gigabytes of chunks.
-                raise HBMError(
-                    f"{sess.name}: staged put of {total} bytes would exceed "
-                    f"HBM cap ({sess.hbm_used}/{sess.memory_cap} used)")
+            # The .npy stream is ~nbytes + a <4 KiB header. CHARGE the
+            # device-bound portion now (not just check): with windowed
+            # streaming many chunks are in flight before the first error
+            # reply lands, and with pipelined sessions several staged puts
+            # can overlap — an upload that cannot fit under the HBM cap
+            # must be refused before gigabytes move, atomically against
+            # other reservations. Released at commit (where the real
+            # device buffer is re-charged) or abort.
+            charged = max(total - 4096, 0)
+            self._charge(sess, charged)
             sid = sess.fresh_id()
-            sess.staging[sid] = (total, bytearray(total))
+            sess.staging[sid] = (total, bytearray(total), charged)
             return {"ok": True, "staging": sid}
 
         if op == "put_chunk":
-            total, raw = sess.staging[int(req["staging"])]
+            total, raw, _charged = sess.staging[int(req["staging"])]
+            if state.get("blob_sunk"):
+                # the connection reader already received the payload
+                # straight into `raw` (see _blob_sink) — nothing to copy
+                return {"ok": True}
             blob = state["blob"] or b""
             off = int(req["offset"])
             if off < 0 or off + len(blob) > total:
@@ -438,36 +502,45 @@ class ChipProxy:
             return {"ok": True}
 
         if op == "put_commit":
-            total, raw = sess.staging.pop(int(req["staging"]))
+            total, raw, charged = sess.staging.pop(int(req["staging"]))
+            # the put_begin reservation hands over to the real device
+            # charge taken by _put_array
+            sess.hbm_used -= charged
             # load_array views the bytearray directly — bytes(raw) would
             # double peak host memory on checkpoint-sized uploads
             return self._put_array(sess, load_array(raw, writable=False))
 
         if op == "put_abort":
-            sess.staging.pop(int(req["staging"]), None)
+            entry = sess.staging.pop(int(req["staging"]), None)
+            if entry is not None:
+                sess.hbm_used -= entry[2]
             return {"ok": True}
 
         if op == "get":
             handle = int(req["handle"])
             buf = sess.buffers[handle]
             if "offset" in req:
-                # Sliced fetch: serialize once, cache the stream, serve byte
-                # ranges. The cache is evicted when the final byte is served
-                # (or the handle is freed), so at most one host copy lives
-                # per session regardless of how the client paces its reads.
+                # Sliced fetch: serialize once, cache the PARTS (header +
+                # a flat view over the device→host copy — dump_array_parts
+                # never joins, so caching costs exactly that one copy),
+                # serve byte ranges via slice_buffers. The cache is evicted
+                # when the final byte is served (or the handle is freed),
+                # so at most one host copy lives per session regardless of
+                # how the client paces its reads.
                 if sess.fetch_cache is None or sess.fetch_cache[0] != handle:
                     with self._dlock:
-                        sess.fetch_cache = (handle, dump_array(buf))
-                blob = sess.fetch_cache[1]
+                        parts = protocol.dump_array_parts(buf)
+                    sess.fetch_cache = (handle, parts,
+                                        protocol.buffers_nbytes(parts))
+                _, parts, total = sess.fetch_cache
                 off, length = int(req["offset"]), int(req["length"])
                 if off < 0 or length <= 0:
                     raise ValueError(f"bad slice [{off}, +{length})")
-                if off + length >= len(blob):
+                if off + length >= total:
                     sess.fetch_cache = None
-                # memoryview: a bytes slice would copy the whole chunk a
-                # second time (send_msg writes buffers as-is)
-                state["reply_blob"] = memoryview(blob)[off:off + length]
-                return {"ok": True, "total": len(blob)}
+                state["reply_blob"] = protocol.slice_buffers(parts, off,
+                                                             length)
+                return {"ok": True, "total": total}
             if int(buf.nbytes) > protocol.MAX_FRAME - 4096:
                 # An over-frame reply would raise in the server's *send*
                 # path, tearing down the connection — and with it the whole
@@ -565,10 +638,18 @@ class ChipProxy:
                 # Live _Executables keep their direct prog reference;
                 # eviction only stops FUTURE compiles from sharing it.
                 self._programs.pop(next(iter(self._programs)))
+        in_meta = [(tuple(a.shape), np.dtype(a.dtype))
+                   for a in exported.in_avals]
+        out_sizes = [int(np.prod(shape or [1])) * np.dtype(dtype).itemsize
+                     for shape, dtype in out_meta]
+        nonempty = [(n, i) for i, n in enumerate(out_sizes) if n > 0]
+        sync_out = ((-1, False) if not nonempty
+                    else (min(nonempty)[1], min(nonempty)[0] > 65536))
         exec_id = sess.fresh_id()
         sess.executables[exec_id] = _Executable(
             exec_id, exported.call, in_specs, out_nbytes, out_meta,
-            prog=prog, ncarry=None if ncarry is None else int(ncarry))
+            prog=prog, ncarry=None if ncarry is None else int(ncarry),
+            in_meta=in_meta, sync_out=sync_out)
         return {"ok": True, "exec_id": exec_id,
                 "out_meta": out_meta, "out_nbytes": out_nbytes}
 
@@ -692,12 +773,13 @@ class ChipProxy:
         if len(args) != len(exe.in_specs):
             raise ValueError(f"expected {len(exe.in_specs)} args, "
                              f"got {len(args)}")
-        for i, (buf, spec) in enumerate(zip(args, exe.in_specs)):
-            if (tuple(buf.shape) != tuple(spec.shape)
-                    or str(buf.dtype) != str(spec.dtype)):
+        # direct tuple/np.dtype comparison against the compile-time
+        # in_meta — stringifying dtypes here costs ~10 µs per dispatch
+        for i, (buf, (shape, dtype)) in enumerate(zip(args, exe.in_meta)):
+            if tuple(buf.shape) != shape or buf.dtype != dtype:
                 raise ValueError(
                     f"arg {i}: got {tuple(buf.shape)}/{buf.dtype}, program "
-                    f"expects {tuple(spec.shape)}/{spec.dtype}")
+                    f"expects {shape}/{dtype}")
         donate = [int(h) for h in req.get("donate", [])]
         chain_steps = int(req.get("chain_steps", 0))
         if chain_steps:
@@ -730,7 +812,7 @@ class ChipProxy:
 
         def run_tagged():
             try:
-                return self._run_fn(fn, args, timing)
+                return self._run_fn(fn, args, timing, exe.sync_out)
             except Exception as e:
                 raise _ExecutionError(e) from e
 
@@ -776,7 +858,12 @@ class ChipProxy:
             buf = sess.buffers.pop(handle, None)
             if buf is not None:
                 sess.hbm_used -= int(buf.nbytes)
-        return {"ok": True, "handles": handles, "repeat": repeat}
+        rep = {"ok": True, "handles": handles}
+        if repeat != 1 or int(req.get("repeat", 1)) != 1:
+            # only loop dispatches consume the echoed clamp; plain executes
+            # skip the key to keep the hot-path reply frame minimal
+            rep["repeat"] = repeat
+        return rep
 
     def _update_cost_model(self, exe: _Executable, repeat: int,
                            burst_ms: float) -> None:
@@ -842,7 +929,8 @@ class ChipProxy:
 
             def run_tagged():
                 try:
-                    return self._run_fn(fn, carry + consts, timing)
+                    return self._run_fn(fn, carry + consts, timing,
+                                        exe.sync_out)
                 except Exception as e:
                     raise _ExecutionError(e) from e
 
@@ -909,7 +997,8 @@ class ChipProxy:
         if bursts > 0:
             sess.hbm_used -= exe.out_nbytes
 
-    def _run_fn(self, fn, args: list, timing: dict | None = None):
+    def _run_fn(self, fn, args: list, timing: dict | None = None,
+                sync_out: tuple | None = None):
         # _dlock inside the token gate: execution is already exclusive per
         # the scheduler, but a concurrent put/get/compile from another
         # connection must not drive the transport while this runs. Device
@@ -921,17 +1010,32 @@ class ChipProxy:
                 outs = fn(*args)
                 if not isinstance(outs, (list, tuple)):
                     outs = [outs]
-                self._jax.block_until_ready(outs)
                 # block_until_ready is NOT a completion barrier on the
                 # tunnelled axon backend (observed: it returns while the
                 # program is still running, until transport backpressure
                 # kicks in) — which would zero out quota accounting and let
                 # a client queue bursts past its token. A host read of the
-                # smallest output cannot complete before the program does.
-                nonempty = [o for o in outs if getattr(o, "nbytes", 0) > 0]
-                if nonempty:  # all-empty outputs: block_until_ready only
-                    small = min(nonempty, key=lambda o: o.nbytes)
-                    if small.nbytes > 65536:
+                # smallest output cannot complete before the program does —
+                # and since every output comes from the SAME XLA program,
+                # that one read is a barrier for all of them, so
+                # block_until_ready is only needed in the all-empty-outputs
+                # fallback. ``sync_out`` is the pick precomputed at compile
+                # time (_Executable.sync_out) — scanning jax .nbytes
+                # properties per dispatch costs ~25 µs and this runs per op
+                # on the pipelined transport's serial stage.
+                if sync_out is None:
+                    nonempty = [o for o in outs
+                                if getattr(o, "nbytes", 0) > 0]
+                    small = (min(nonempty, key=lambda o: o.nbytes)
+                             if nonempty else None)
+                    big = small is not None and small.nbytes > 65536
+                else:
+                    idx, big = sync_out
+                    small = outs[idx] if 0 <= idx < len(outs) else None
+                if small is None:     # all-empty: block_until_ready only
+                    self._jax.block_until_ready(outs)
+                else:
+                    if big:
                         # Don't haul a big buffer to host just to sync:
                         # a 1-element slice is a dependent dispatch that
                         # completes strictly after the program.

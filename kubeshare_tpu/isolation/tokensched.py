@@ -20,6 +20,7 @@ from __future__ import annotations
 import ctypes
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
@@ -308,7 +309,12 @@ class TokenScheduler:
         self._core = make_core(window_ms, base_quota_ms, min_quota_ms, native)
         self._cond = threading.Condition()
         self._grants: dict[str, float] = {}  # name -> granted quota_ms
-        self._waiting: set[str] = set()      # names with a blocked waiter
+        # name -> FIFO of waiter tickets. A client is ONE token stream in
+        # the core, but a pipelined connection dispatches gated ops
+        # concurrently — multiple façade-level waiters per name must
+        # queue, in arrival order, for that single stream (head-of-queue
+        # consumes each grant; the rest re-arm the core's request).
+        self._waiting: dict[str, deque] = {}
         self._held_since: dict[str, float] = {}  # name -> grant wall time
         self._clock = clock or _now_ms
         self.window_ms = window_ms
@@ -340,14 +346,6 @@ class TokenScheduler:
             self._note_grant(name, time.monotonic() - t0, trace_id)
             return quota
 
-    def _enter_wait(self, name: str) -> None:
-        # A client is one token stream: a second concurrent waiter for the
-        # same name would race the single grant slot (one pops it, the
-        # other re-waits with no pending request — forever). Fail fast.
-        if name in self._waiting:
-            raise RuntimeError(f"{name}: token request already in flight")
-        self._waiting.add(name)
-
     def renew(self, name: str, used_ms: float, timeout: float | None = None,
               trace_id: str = "") -> float:
         """Atomically release + re-request + wait for the next grant.
@@ -371,9 +369,27 @@ class TokenScheduler:
             self._note_grant(name, time.monotonic() - t0, trace_id)
             return quota
 
+    def _take_grant(self, name: str, q: deque) -> float:
+        # Caller holds self._cond; a grant for `name` exists and this
+        # thread's ticket is the queue head. With more same-name waiters
+        # queued, re-arm the core's (idempotent) request flag so the next
+        # release can grant the stream again — the core granted once and
+        # cleared it.
+        quota = self._grants.pop(name)
+        if len(q) > 1:
+            self._core.request_token(name)
+            self._cond.notify_all()
+        return quota
+
     def _wait_for_grant(self, name: str, deadline: float | None) -> float:
         # Caller holds self._cond and has already requested the token.
-        self._enter_wait(name)
+        # FIFO among same-name waiters: only the ticket at the head of the
+        # queue may consume a grant, so concurrent gated ops on one client
+        # are served strictly in arrival order (no barging, no lost
+        # grants).
+        ticket = object()
+        q = self._waiting.setdefault(name, deque())
+        q.append(ticket)
         try:
             while True:
                 result = self._core.poll(self._clock())
@@ -381,8 +397,8 @@ class TokenScheduler:
                     granted, quota = result
                     self._grants[granted] = quota
                     self._cond.notify_all()
-                if name in self._grants:
-                    return self._grants.pop(name)
+                if name in self._grants and q[0] is ticket:
+                    return self._take_grant(name, q)
                 try:
                     self._core.window_usage(name, self._clock())
                 except KeyError:
@@ -400,16 +416,28 @@ class TokenScheduler:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         # Withdraw cleanly: consume-and-return a grant that
-                        # raced in, else clear the waiting flag so the core
+                        # raced in (head only), else — when this was the
+                        # only waiter — clear the core's waiting flag so it
                         # never hands out a token nobody will consume.
-                        if name in self._grants:
-                            return self._grants.pop(name)
-                        self._core.cancel_request(name)
+                        # Queued waiters behind this one keep the request
+                        # armed.
+                        if name in self._grants and q[0] is ticket:
+                            return self._take_grant(name, q)
+                        if len(q) == 1:
+                            self._core.cancel_request(name)
                         raise TimeoutError(f"{name}: token wait timed out")
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
         finally:
-            self._waiting.discard(name)
+            try:
+                q.remove(ticket)
+            except ValueError:  # pragma: no cover - ticket appended above
+                pass
+            if not q:
+                self._waiting.pop(name, None)
+            # wake the next same-name ticket (now head) so it can claim a
+            # pending grant or resume polling
+            self._cond.notify_all()
 
     def _note_grant(self, name: str, wait_s: float, trace_id: str) -> None:
         # caller holds self._cond; a timed-out wait raised before this

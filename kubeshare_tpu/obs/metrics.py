@@ -84,11 +84,16 @@ class _Metric:
         self._series: Dict[Tuple[str, ...], object] = {}
 
     def _key(self, label_values: Sequence[str]) -> Tuple[str, ...]:
-        values = tuple(str(v) for v in label_values)
-        if len(values) != len(self.label_keys):
+        if len(label_values) != len(self.label_keys):
             raise ValueError("%s expects labels %r, got %r"
-                             % (self.name, self.label_keys, values))
-        return values
+                             % (self.name, self.label_keys,
+                                tuple(label_values)))
+        # fast path: transport hot paths record per op, and their label
+        # values are already strings — skip the genexp + str() round-trip
+        for v in label_values:
+            if type(v) is not str:
+                return tuple(str(v) for v in label_values)
+        return tuple(label_values)
 
     def _labels_dict(self, key: Tuple[str, ...]) -> dict:
         return dict(zip(self.label_keys, key))

@@ -11,16 +11,22 @@ amortizes; on a local chip it is the whole added cost):
   register→execute→reply, p50/p99 — the per-dispatch floor the fused
   loop amortizes away.
 - ``put/get_gbps``: host↔proxy buffer bandwidth over the framed socket
-  (64 MiB array, chunked path).
+  (64 MiB array, chunked path — windowed streaming when negotiated).
 - ``fused_loop_per_step_us``: marginal cost per fused training step at
   a 64-step burst — what co-located clients actually pay per step.
+- ``async_dispatch_ops_per_sec``: small-op throughput with a window of
+  ``execute_async`` futures in flight — the pipelined transport's
+  multiplexing win over the lockstep ``single_dispatch`` rate.
 
 Run: ``python scripts/bench_proxy.py`` → one JSON object
-(committed as ``bench_proxy.json``).
+(committed as ``bench_proxy.json``). ``--baseline FILE`` also prints
+deltas vs a committed baseline; ``--write FILE`` saves the fresh
+numbers (``make bench-proxy`` does both against ``bench_proxy.json``).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import statistics
 import sys
@@ -29,8 +35,41 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+#: keys worth a delta line (the rest of the JSON is descriptive)
+_METRICS = ("execute_rtt_ms_p50", "execute_rtt_ms_p99", "put_gbps",
+            "get_gbps", "fused_loop_per_step_us", "single_dispatch_ms_p50",
+            "async_dispatch_ops_per_sec")
+#: metrics where larger is better (the rest are latencies)
+_HIGHER_IS_BETTER = ("put_gbps", "get_gbps", "async_dispatch_ops_per_sec")
 
-def main() -> None:
+
+class _ProxyProcess:
+    """The chip proxy in its own process — the deployment shape (client
+    pods talk to one resident proxy process over a local socket). An
+    in-process proxy shares the client's GIL, which serializes the very
+    overlap the pipelined-transport numbers measure."""
+
+    def __init__(self):
+        import subprocess
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "kubeshare_tpu.isolation.proxy",
+             "-P", "0", "--platform", "cpu"],
+            stdout=subprocess.PIPE, text=True,
+            cwd=str(Path(__file__).resolve().parent.parent))
+        line = self._proc.stdout.readline()
+        if not line.startswith("READY "):
+            raise RuntimeError(f"proxy failed to start: {line!r}")
+        self.port = int(line.split()[1])
+
+    def close(self):
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=10)
+        except Exception:
+            self._proc.kill()
+
+
+def run_bench(in_process: bool = False) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -38,11 +77,14 @@ def main() -> None:
     import numpy as np
 
     from kubeshare_tpu.isolation.client import ProxyClient
-    from kubeshare_tpu.isolation.proxy import ChipProxy
-    from kubeshare_tpu.isolation.tokensched import TokenScheduler
 
-    proxy = ChipProxy(scheduler=TokenScheduler())
-    proxy.serve()
+    if in_process:
+        from kubeshare_tpu.isolation.proxy import ChipProxy
+        from kubeshare_tpu.isolation.tokensched import TokenScheduler
+        proxy = ChipProxy(scheduler=TokenScheduler())
+        proxy.serve()
+    else:
+        proxy = _ProxyProcess()
     out: dict = {"bench": "proxy transport overhead (CPU backend)"}
     try:
         with ProxyClient("127.0.0.1", proxy.port, "bench", 1.0, 1.0) as c:
@@ -60,6 +102,52 @@ def main() -> None:
             out["execute_rtt_ms_p50"] = round(statistics.median(rtts), 3)
             out["execute_rtt_ms_p99"] = round(
                 sorted(rtts)[int(len(rtts) * 0.99) - 1], 3)
+
+            # --- async (windowed) small-op dispatch throughput ----------
+            # a window of execute_async futures rides the multiplexed
+            # connection; each op still passes the token gate and device
+            # dispatch — the win is overlap, not skipped work
+            window = 64
+            n_ops = 2000
+            pending: list = []
+            done_handles: list[int] = []
+
+            def drain_one():
+                out_handles = pending.pop(0).result()
+                done_handles.extend(out_handles)
+
+            # defer=True corks submits (Connection.CORK_FRAMES per write);
+            # the window is deep enough that the head future being drained
+            # was always flushed long ago — only the final drain needs an
+            # explicit flush()
+            for _ in range(200):          # warm the pipelined path
+                pending.append(c.execute_async(exe._exec_id, [buf.handle],
+                                               defer=True))
+            c.flush()
+            while pending:
+                drain_one()
+            rates = []
+            for _ in range(3):            # median beats one noisy sample
+                c._conn.call({"op": "free", "name": c.name,
+                              "handles": done_handles})
+                done_handles.clear()
+                t0 = time.perf_counter()
+                for _ in range(n_ops):
+                    if len(pending) >= window:
+                        drain_one()
+                    pending.append(
+                        c.execute_async(exe._exec_id, [buf.handle],
+                                        defer=True))
+                c.flush()
+                while pending:
+                    drain_one()
+                rates.append(n_ops / (time.perf_counter() - t0))
+            out["async_dispatch_ops_per_sec"] = round(
+                statistics.median(rates), 0)
+            # free in batches: one giant handle list would dwarf MAX_FRAME
+            for i in range(0, len(done_handles), 1000):
+                c._conn.call({"op": "free", "name": c.name,
+                              "handles": done_handles[i:i + 1000]})
 
             # --- transfer bandwidth (chunked path) ----------------------
             big = np.random.default_rng(0).random(
@@ -114,7 +202,49 @@ def main() -> None:
                 statistics.median(n1) * 1e3, 3)
     finally:
         proxy.close()
+    return out
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:28s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:28s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="bench_proxy")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--in-process", action="store_true",
+                        help="run the proxy inside this interpreter "
+                             "(debugging; shares the GIL with the client)")
+    args = parser.parse_args(argv)
+    out = run_bench(in_process=args.in_process)
     print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
 
 
 if __name__ == "__main__":

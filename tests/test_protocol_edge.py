@@ -1,0 +1,212 @@
+"""Wire-level edge cases for the framed-JSON protocol.
+
+The framing invariants these pin down (doc/isolation-wire.md):
+``FrameTooLarge`` is raised strictly BEFORE any bytes hit the wire, so
+the stream stays in sync and the connection survives; ``ProtocolError``
+means the stream is (or may be) desynced and the connection must die.
+Plus the scatter-gather send path's byte accounting (non-byte
+memoryviews, zero-byte blobs, exact boundaries) and the pipelined
+connection's multiplexing.
+"""
+
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from kubeshare_tpu.isolation import protocol
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+# -- framing: send/recv symmetry ---------------------------------------------
+
+
+def test_blob_at_exact_max_frame_boundary(monkeypatch):
+    monkeypatch.setattr(protocol, "MAX_FRAME", 4096)
+    a, b = _pair()
+    try:
+        payload = b"x" * 4096  # exactly MAX_FRAME: allowed, not rejected
+        protocol.send_msg(a, {"op": "edge"}, blob=payload)
+        msg, blob = protocol.recv_msg(b)
+        assert msg["op"] == "edge"
+        assert bytes(blob) == payload
+        with pytest.raises(protocol.FrameTooLarge):
+            protocol.send_msg(a, {"op": "edge"}, blob=b"x" * 4097)
+        # the refused send wrote NOTHING: the stream is still usable
+        protocol.send_msg(a, {"op": "after"})
+        msg, blob = protocol.recv_msg(b)
+        assert msg["op"] == "after" and blob is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_json_is_refused_pre_send(monkeypatch):
+    monkeypatch.setattr(protocol, "MAX_FRAME", 256)
+    a, b = _pair()
+    try:
+        with pytest.raises(protocol.FrameTooLarge):
+            protocol.send_msg(a, {"op": "x", "pad": "y" * 1024})
+        protocol.send_msg(a, {"op": "fits"})
+        msg, _ = protocol.recv_msg(b)
+        assert msg["op"] == "fits"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_zero_byte_blob_roundtrips():
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "empty"}, blob=b"")
+        msg, blob = protocol.recv_msg(b)
+        # an announced empty blob is an empty buffer, NOT "no blob"
+        assert blob is not None and len(blob) == 0
+        assert "_blob" not in msg
+    finally:
+        a.close()
+        b.close()
+
+
+def test_non_byte_memoryview_parts_account_in_bytes():
+    """send_msg must frame by nbytes, not element count — an int32 view
+    framed by len() would desync the stream 4x."""
+    a, b = _pair()
+    try:
+        arr = np.arange(32, dtype=np.int32)
+        wide = memoryview(arr)
+        assert wide.format == "i"  # genuinely non-byte
+        protocol.send_msg(a, {"op": "wide"}, blob=[wide, b"tail"])
+        msg, blob = protocol.recv_msg(b)
+        assert bytes(blob) == arr.tobytes() + b"tail"
+        # stream still aligned after the multi-part payload
+        protocol.send_msg(a, {"op": "next"})
+        msg, _ = protocol.recv_msg(b)
+        assert msg["op"] == "next"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_truncated_frame_mid_blob_raises_protocol_error():
+    a, b = _pair()
+    try:
+        body = json.dumps({"op": "x", "_blob": 100}).encode()
+        a.sendall(struct.pack(">I", len(body)) + body + b"z" * 40)
+        a.close()  # peer dies 60 bytes short of its announced payload
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_garbage_length_header_raises_protocol_error():
+    a, b = _pair()
+    try:
+        a.sendall(b"\xff\xff\xff\xff" + b"junk")
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_into_sink_lands_payload_in_place():
+    a, b = _pair()
+    try:
+        payload = bytes(range(64))
+        dest = bytearray(64)
+        protocol.send_msg(a, {"op": "s"}, blob=payload)
+        _, blob = protocol.recv_msg(b, sink=memoryview(dest))
+        assert isinstance(blob, memoryview) and blob.obj is dest
+        assert bytes(dest) == payload
+    finally:
+        a.close()
+        b.close()
+
+
+# -- server behavior ----------------------------------------------------------
+
+
+@pytest.fixture
+def echo_server():
+    def handle(req, state):
+        if req.get("op") == "echo":
+            state["reply_blob"] = state.get("blob")
+            return {"ok": True}
+        if req.get("op") == "bigreply":
+            state["reply_blob"] = b"x" * int(req["n"])
+            return {"ok": True}
+        return {"ok": True, "op": req.get("op")}
+
+    cleaned = threading.Event()
+    server = protocol.serve_framed("127.0.0.1", 0, handle,
+                                   cleanup=lambda s: cleaned.set())
+    yield server.server_address[1], cleaned
+    server.shutdown()
+    server.server_close()
+
+
+def test_server_garbage_header_tears_down_connection(echo_server):
+    port, cleaned = echo_server
+    s = socket.create_connection(("127.0.0.1", port))
+    try:
+        s.sendall(b"\xff\xff\xff\xff")
+        assert s.recv(1) == b""  # ProtocolError server-side: clean close
+        assert cleaned.wait(5.0)
+    finally:
+        s.close()
+
+
+def test_server_oversized_reply_is_error_not_teardown(echo_server,
+                                                      monkeypatch):
+    """A reply blob over the frame cap is refused PRE-send (stream in
+    sync), so the server reports it instead of silently dropping the
+    reply or killing the connection."""
+    monkeypatch.setattr(protocol, "MAX_FRAME", 1 << 16)
+    port, _ = echo_server
+    with protocol.Connection("127.0.0.1", port) as conn:
+        with pytest.raises(RuntimeError, match="FrameTooLarge"):
+            conn.call({"op": "bigreply", "n": (1 << 16) + 1})
+        reply, blob = conn.call({"op": "echo"}, blob=b"still alive")
+        assert bytes(blob) == b"still alive"
+
+
+def test_pipelined_connection_multiplexes(echo_server):
+    port, _ = echo_server
+    conn = protocol.Connection("127.0.0.1", port)
+    conn.start_pipeline()
+    try:
+        # more in flight than SERVER_CREDIT: backpressure, not deadlock —
+        # a lockstep transport could not submit #2 before reading #1
+        reps = [conn.submit({"op": "echo", "i": i}, blob=str(i).encode())
+                for i in range(3 * protocol.SERVER_CREDIT)]
+        for i, rep in enumerate(reps):
+            msg, blob = rep.result(timeout=30)
+            assert msg["ok"] and bytes(blob) == str(i).encode()
+    finally:
+        conn.close()
+
+
+def test_pipelined_connection_fails_all_pending_on_death(echo_server):
+    port, _ = echo_server
+    conn = protocol.Connection("127.0.0.1", port)
+    conn.start_pipeline()
+    rep = conn.submit({"op": "echo"}, blob=b"x")
+    rep.result(timeout=30)
+    conn.close()
+    with pytest.raises(protocol.ProtocolError):
+        conn.submit({"op": "echo"})
+
+
+def test_negotiate_features_intersects():
+    assert protocol.negotiate_features(["seq", "frobnicate"]) == ["seq"]
+    assert protocol.negotiate_features([]) == []
+    assert protocol.negotiate_features(("seq",)) == ["seq"]

@@ -854,11 +854,11 @@ def test_chained_loop_midchain_failure_consumes_carry(proxy, monkeypatch):
         calls = {"n": 0}
         real = proxy._run_fn
 
-        def flaky(fn, args, timing=None):
+        def flaky(fn, args, timing=None, sync_out=None):
             calls["n"] += 1
             if calls["n"] > 1:           # burst 0 succeeds, burst 1 dies
                 raise RuntimeError("injected device failure")
-            return real(fn, args, timing)
+            return real(fn, args, timing, sync_out)
 
         monkeypatch.setattr(proxy, "_run_fn", flaky)
         with pytest.raises(RuntimeError, match="carry was consumed"):
@@ -890,3 +890,139 @@ def test_chained_loop_hbm_cap_returns_partial(proxy):
         assert 1 <= loop.last_n < 10_000
         got = c.get(carry)
         np.testing.assert_allclose(got, np.full(4, float(loop.last_n)))
+
+
+# -- pipelined transport (ISSUE 2) ------------------------------------------
+
+
+def test_old_protocol_client_compat_roundtrip(proxy):
+    """An unnegotiated (seed-wire) lockstep client — no `features` key, no
+    `_seq` — must round-trip put/execute/get against the pipelined proxy
+    byte-for-byte, with the reply shapes it has always seen."""
+    import socket as socket_mod
+
+    from jax import export as jax_export
+
+    sock = socket_mod.create_connection(("127.0.0.1", proxy.port))
+
+    def call(msg, blob=None):
+        protocol.send_msg(sock, msg, blob)
+        reply, rblob = protocol.recv_msg(sock)
+        assert reply.get("ok"), reply
+        return reply, rblob
+
+    try:
+        reply, _ = call({"op": "register", "name": "old", "request": 0.5,
+                         "limit": 1.0})
+        assert "features" not in reply       # reply shape unchanged
+        assert protocol.SEQ_KEY not in reply  # no seq tag on lockstep wire
+        arr = np.arange(256, dtype=np.float32)
+        reply, _ = call({"op": "put", "name": "old"},
+                        blob=bytes(protocol.dump_array(arr)))
+        handle = reply["handle"]
+
+        exported = jax_export.export(
+            jax.jit(lambda x: x + 1.0),
+            platforms=[proxy.platform])(jax.ShapeDtypeStruct((256,),
+                                                             np.float32))
+        reply, _ = call({"op": "compile", "name": "old"},
+                        blob=exported.serialize())
+        reply, _ = call({"op": "execute", "name": "old",
+                         "exec_id": reply["exec_id"], "args": [handle],
+                         "donate": []})
+        assert protocol.SEQ_KEY not in reply
+        out_handle = reply["handles"][0]
+
+        reply, blob = call({"op": "get", "name": "old",
+                            "handle": out_handle, "offset": 0,
+                            "length": 1 << 20})
+        assert int(reply["total"]) == len(blob)
+        # byte-for-byte: the fetched stream is exactly the .npy encoding
+        assert bytes(blob) == bytes(protocol.dump_array(
+            np.asarray(arr + np.float32(1.0))))
+        np.testing.assert_array_equal(protocol.load_array(blob),
+                                      arr + 1.0)
+    finally:
+        sock.close()
+
+
+def test_register_negotiates_seq_feature(proxy):
+    with connect(proxy, "c") as c:
+        assert "seq" in c.features
+        assert c._conn.pipelined
+
+
+def test_execute_async_resolves_out_of_submission_wait_order(proxy):
+    with connect(proxy, "c") as c:
+        x = np.float32(1.0)
+        exe = c.compile(lambda a: a + 1.0, x)
+        bx = c.put(x)
+        futs = [exe.call_async(bx) for _ in range(12)]
+        # wait in REVERSE submission order: every future must still
+        # resolve (per-seq tagging, not positional matching)
+        outs = [f.result() for f in reversed(futs)]
+        for o in outs:
+            assert float(c.get(o)) == 2.0
+        c.free(*outs)
+
+
+def test_async_failure_surfaces_at_result(proxy):
+    with connect(proxy, "c") as c:
+        x = np.float32(1.0)
+        exe = c.compile(lambda a: a + 1.0, x)
+        bx = c.put(x)
+        good = exe.call_async(bx)
+        c.free(bx)
+        bad = exe.call_async(bx)        # handle freed: remote error
+        good.result()
+        with pytest.raises(Exception):
+            bad.result()
+        # connection survived the failed op
+        assert c.usage()["ok"]
+
+
+def test_put_abort_mid_window_keeps_session(proxy):
+    """A chunk refused mid-window must not desync the stream: later
+    in-flight chunks complete, put_abort lands, and the session (and its
+    HBM reservation) is fully recovered."""
+    with connect(proxy, "c") as c:
+        conn = c._conn
+        reply, _ = conn.call({"op": "put_begin", "name": "c",
+                              "nbytes": 1 << 16})
+        sid = reply["staging"]
+        reps = [
+            conn.submit({"op": "put_chunk", "name": "c", "staging": sid,
+                         "offset": 0}, blob=b"x" * 1024),
+            # out-of-range: fails server-side while later chunks are in
+            # flight behind it
+            conn.submit({"op": "put_chunk", "name": "c", "staging": sid,
+                         "offset": (1 << 16) - 10}, blob=b"y" * 1024),
+            conn.submit({"op": "put_chunk", "name": "c", "staging": sid,
+                         "offset": 2048}, blob=b"z" * 1024),
+        ]
+        outcomes = []
+        for r in reps:
+            try:
+                r.result(timeout=30)
+                outcomes.append("ok")
+            except RuntimeError:
+                outcomes.append("err")
+        assert outcomes == ["ok", "err", "ok"]
+        conn.call({"op": "put_abort", "name": "c", "staging": sid})
+        # the put_begin HBM reservation was released by the abort
+        assert c.usage()["hbm_used"] == 0
+        arr = np.arange(8, dtype=np.float32)
+        np.testing.assert_array_equal(c.get(c.put(arr)), arr)
+
+
+def test_windowed_put_get_roundtrip_many_chunks(proxy):
+    """Windowed streaming with many chunks in flight (window > 2 chunks,
+    several windows deep) reassembles exactly."""
+    with connect(proxy, "c") as c:
+        c.chunk_bytes = 1 << 14          # 16 KiB chunks
+        rng = np.random.default_rng(7)
+        arr = rng.standard_normal((320, 320)).astype(np.float32)  # ~400 KiB
+        buf = c.put(arr)
+        np.testing.assert_array_equal(c.get(buf), arr)
+        got = c.get(buf)
+        assert got.flags.writeable       # user-facing array stays mutable

@@ -252,32 +252,41 @@ def test_renew_preserves_stride_shares():
     assert 0.62 <= share <= 0.78, share
 
 
-def test_concurrent_waiters_same_name_rejected():
-    """One client = one token stream: a second in-flight request for the
-    same name would race the single grant slot; it must fail fast."""
+def test_concurrent_waiters_same_name_fifo():
+    """One client = one token stream, but a pipelined connection issues
+    gated ops concurrently: same-name waiters must QUEUE and be granted
+    strictly in arrival order — every waiter served, no lost grants."""
     sched = TokenScheduler(WINDOW, BASE, MIN)
     sched.add_client("a", 0.5, 1.0)
     sched.add_client("b", 0.5, 1.0)
     sched.acquire("a")  # a holds the token; b's waiters will block
+    order: list[str] = []
     errs: list[Exception] = []
-    started = threading.Event()
 
-    def waiter():
-        started.set()
+    def waiter(tag: str, entered: threading.Event):
+        entered.set()
         try:
-            sched.acquire("b", timeout=2.0)
+            sched.acquire("b", timeout=10.0)
+            order.append(tag)
+            time.sleep(0.02)
+            sched.release("b", 1.0)
         except Exception as e:
             errs.append(e)
 
-    t = threading.Thread(target=waiter)
-    t.start()
-    started.wait()
-    time.sleep(0.05)  # let the first waiter enter the wait
-    with pytest.raises(RuntimeError, match="already in flight"):
-        sched.acquire("b", timeout=0.5)
+    threads = []
+    for tag in ("first", "second", "third"):
+        ev = threading.Event()
+        t = threading.Thread(target=waiter, args=(tag, ev))
+        t.start()
+        ev.wait()
+        time.sleep(0.05)  # serialize queue entry so arrival order is known
+        threads.append(t)
     sched.release("a", 1.0)
-    t.join(timeout=5.0)
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive()
     assert not errs, errs
+    assert order == ["first", "second", "third"]
 
 
 def test_waiter_errors_when_client_removed():
