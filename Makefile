@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery image clean obs-check
 
 all: native
 
@@ -45,6 +45,13 @@ bench:
 bench-proxy:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_proxy.py \
 		--baseline bench_proxy.json --write bench_proxy.json
+
+# Recovery micro-bench (doc/isolation-wire.md, resume/replay section):
+# reconnect latency p50/p99, replay throughput across a kill, and
+# end-to-end live-migration time; refreshes bench_recovery.json.
+bench-recovery:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_recovery.py \
+		--baseline bench_recovery.json --write bench_recovery.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

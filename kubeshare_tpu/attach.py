@@ -37,6 +37,7 @@ through env vars alone.
 
 from __future__ import annotations
 
+import atexit
 import os
 import threading
 
@@ -398,6 +399,12 @@ def attach_proxy(host: str, port: int, name: str, request: float,
         originals = _guard_proxy_surface(jax)
         _active = _AttachState("proxy", real_jit, shim=shim,
                                originals=originals)
+        # A zero-touch workload never calls detach(); unregister at
+        # interpreter exit so the proxy drops the session immediately
+        # instead of parking it (resume-capable sessions survive a dead
+        # connection for the detach grace — right for a crash, wrong for
+        # a clean exit). detach() is idempotent.
+        atexit.register(detach)
         log.info("attached (proxy mode) to %s:%d as %s "
                  "(request=%.2f limit=%.2f)", host, port, name, request, limit)
 
@@ -521,6 +528,7 @@ def attach_gate(host: str, port: int, name: str, request: float,
         originals = _meter_eager_ops(jax, gate, hbm)
         _active = _AttachState("gate", real_jit, gate=gate,
                                originals=originals)
+        atexit.register(detach)   # release the token on clean exit
         log.info("attached (gate mode) to %s:%d as %s", host, port, name)
 
 

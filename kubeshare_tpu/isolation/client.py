@@ -253,27 +253,51 @@ class ProxyClient:
 
     def __init__(self, host: str, port: int, name: str, request: float,
                  limit: float, memory: int = 0, timeout: float | None = None,
-                 chunk_bytes: int = 64 << 20, trace_id: str = ""):
+                 chunk_bytes: int = 64 << 20, trace_id: str = "",
+                 reconnect="auto", fault_tag: str = ""):
         self.name = name
         #: transfer slab size for put/get; arrays whose serialized form
         #: exceeds it stream in slices, so checkpoint-sized buffers cross a
         #: wire whose frame cap is far smaller than the buffer.
         self.chunk_bytes = chunk_bytes
-        self._conn = protocol.Connection(host, port, timeout=timeout,
-                                         trace_id=trace_id)
-        reply, _ = self._conn.call({
+        register = {
             "op": "register", "name": name, "request": request,
             "limit": limit, "memory": memory,
-            # feature negotiation: ask for the pipelined transport; an old
-            # proxy simply ignores the key and omits it from the reply,
-            # leaving this client in lockstep mode
-            "features": list(protocol.FEATURES)})
+            # feature negotiation: ask for the pipelined transport and a
+            # resume token; an old proxy simply ignores the key and omits
+            # it from the reply, leaving this client in lockstep mode
+            # with no resilience — exactly the seed behavior
+            "features": list(protocol.FEATURES)}
+        if reconnect is None:
+            # legacy transport: failures surface immediately, no replay —
+            # and no resume token either, so a dropped connection frees the
+            # session at once instead of parking it for the detach grace
+            register["features"] = [f for f in protocol.FEATURES
+                                    if f != "resume"]
+            self._conn = protocol.Connection(host, port, timeout=timeout,
+                                             trace_id=trace_id,
+                                             fault_tag=fault_tag)
+            reply, _ = self._conn.call(register)
+            if "seq" in frozenset(reply.get("features", ())):
+                self._conn.start_pipeline()
+        else:
+            # "auto" (default) or an explicit ReconnectPolicy: wrap the
+            # channel so peer death becomes reconnect-and-replay. When
+            # the proxy grants no "resume" feature the wrapper degrades
+            # to a passthrough, so this is safe against old proxies.
+            from ..resilience.reconnect import (ReconnectPolicy,
+                                                ResilientConnection)
+            policy = (reconnect if isinstance(reconnect, ReconnectPolicy)
+                      else None)
+            self._conn = ResilientConnection(host, port, timeout=timeout,
+                                             trace_id=trace_id,
+                                             policy=policy,
+                                             fault_tag=fault_tag)
+            reply = self._conn.open(register)
         self.platforms: list[str] = reply["platforms"]
         self.device: str = reply.get("device", "")
         #: transport features BOTH ends agreed on at register
         self.features: frozenset[str] = frozenset(reply.get("features", ()))
-        if "seq" in self.features:
-            self._conn.start_pipeline()
 
     # -- buffers -------------------------------------------------------------
 
@@ -303,7 +327,16 @@ class ProxyClient:
             reply, _ = self._conn.call({"op": "put", "name": self.name},
                                        blob=parts)
         else:
-            reply = self._put_chunked(parts, nbytes, chunk)
+            try:
+                reply = self._put_chunked(parts, nbytes, chunk)
+            except RuntimeError as exc:
+                if "invalidated by disconnect" not in str(exc):
+                    raise
+                # the connection died mid-window and the proxy GC'd the
+                # half-landed staging (its bytes can never be trusted);
+                # the session itself survived — restart the upload once
+                # on the recovered channel
+                reply = self._put_chunked(parts, nbytes, chunk)
         return RemoteBuffer(reply["handle"], tuple(reply["shape"]),
                             reply["dtype"])
 
@@ -595,11 +628,25 @@ class ProxyClient:
         reply, _ = self._conn.call({"op": "usage", "name": self.name})
         return reply
 
+    def set_endpoint(self, host: str, port: int) -> None:
+        """Point future reconnects at a different proxy (the migration
+        flip). Requires a resilient connection."""
+        fn = getattr(self._conn, "set_endpoint", None)
+        if fn is None:
+            raise RuntimeError(
+                "set_endpoint requires reconnect support "
+                "(ProxyClient(..., reconnect='auto'))")
+        fn(host, port)
+
     def close(self) -> None:
-        try:
-            self._conn.call({"op": "unregister", "name": self.name})
-        except Exception:
-            pass
+        if getattr(self._conn, "healthy", True):
+            # unregister only over a live channel: tearing down a LOST
+            # session would otherwise spend the whole reconnect budget
+            # inside close()
+            try:
+                self._conn.call({"op": "unregister", "name": self.name})
+            except Exception:
+                pass
         self._conn.close()
 
     def __enter__(self):

@@ -15,10 +15,13 @@ pod's share without scheduler-side timeouts.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 
 from ..constants import ENV_POD_MANAGER_PORT, ENV_POD_NAME
+from ..resilience.reconnect import (ReconnectPolicy, SessionLost,
+                                    backoff_delays)
 from ..utils.logger import get_logger
 from . import protocol
 
@@ -38,6 +41,12 @@ class PodManager:
     gem-pmgr's kill path, ``launcher.py:58-66``).
     """
 
+    #: bounded budget for the relay's break-and-reconnect: a scheduler
+    #: restart is ridden out in place (podmgr_relay.cpp parity), a
+    #: scheduler that stays down surfaces as SessionLost on the gate
+    RECONNECT = ReconnectPolicy(max_attempts=5, base_delay_s=0.05,
+                                max_delay_s=0.5, dial_timeout_s=2.0)
+
     def __init__(self, scheduler_host: str, scheduler_port: int, pod_name: str,
                  request: float, limit: float,
                  connect_timeout: float | None = None):
@@ -46,7 +55,8 @@ class PodManager:
         self.limit = limit
         self._sched_addr = (scheduler_host, scheduler_port)
         self._up = protocol.Connection(scheduler_host, scheduler_port,
-                                       timeout=connect_timeout)
+                                       timeout=connect_timeout,
+                                       fault_tag="podmgr-up")
         self._up.call({"op": "register", "name": pod_name,
                        "request": request, "limit": limit})
         # registration done: this connection just holds the ownership
@@ -76,22 +86,54 @@ class PodManager:
         if op in ("acquire", "renew", "release", "usage"):
             up = state.get("up")
             if up is None:
-                up = protocol.Connection(*self._sched_addr)
+                up = protocol.Connection(*self._sched_addr,
+                                         fault_tag="podmgr-up")
                 up.call({"op": "attach", "name": self.pod_name})
                 state["up"] = up
+            fwd = dict(req, name=self.pod_name)
             try:
-                reply, _ = up.call(dict(req, name=self.pod_name))
+                reply, _ = up.call(fwd)
             except OSError:
                 # Transport error: Connection.call closed the socket
-                # (fail-stop), so drop the corpse and disarm — the next
-                # call on this gate connection re-dials a fresh upstream
-                # instead of looping on a dead one (parity with
-                # podmgr_relay.cpp's break-and-reconnect, but recovering
-                # in place).
+                # (fail-stop). Break-and-reconnect IN PLACE — the native
+                # relay's behavior (podmgr_relay.cpp): re-dial with
+                # bounded backoff, re-attach, and retry this op once on
+                # the fresh channel, so a scheduler restart is invisible
+                # to the gate. Only an exhausted budget (or a second
+                # failure on the fresh channel) surfaces.
                 state["up"] = None
-                if op in ("acquire", "renew"):
+                up = self._redial_upstream()
+                state["up"] = up
+                if state.get("holding"):
+                    # The scheduler does NOT crash-release on an attached
+                    # connection's death — this pod still holds the
+                    # token. Its usage since the grant is unknowable
+                    # (the old channel took it down), so release with the
+                    # conservative wall-time charge and start fresh: a
+                    # renew becomes a plain acquire (its release half
+                    # already happened here).
                     state["holding"] = False
-                raise
+                    quota = state.get("quota_ms", 0.0)
+                    elapsed = (time.monotonic()
+                               - state.get("grant_t", 0.0)) * 1000.0
+                    try:
+                        up.call({"op": "release", "name": self.pod_name,
+                                 "used_ms": min(max(elapsed, 0.0), quota)})
+                    except Exception:
+                        pass
+                    if op == "renew":
+                        fwd = {"op": "acquire", "name": self.pod_name}
+                        if "timeout" in req:
+                            fwd["timeout"] = req["timeout"]
+                try:
+                    reply, _ = up.call(fwd)
+                except OSError:
+                    # fresh channel died too: disarm and surface (the
+                    # seed's give-up path)
+                    state["up"] = None
+                    if op in ("acquire", "renew"):
+                        state["holding"] = False
+                    raise
             except RuntimeError:
                 # Upstream said ok:false (e.g. renew's re-request timed
                 # out).  The scheduler's renew releases the old token
@@ -116,6 +158,35 @@ class PodManager:
                 state["holding"] = False
             return reply
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _redial_upstream(self) -> protocol.Connection:
+        """Bounded re-dial + re-attach to the token scheduler. Raises
+        :class:`SessionLost` when the budget runs out."""
+        delays = backoff_delays(self.RECONNECT, random.Random())
+        last: Exception | None = None
+        for attempt in range(self.RECONNECT.max_attempts):
+            time.sleep(next(delays))
+            try:
+                up = protocol.Connection(
+                    *self._sched_addr,
+                    timeout=self.RECONNECT.dial_timeout_s,
+                    fault_tag="podmgr-up")
+            except OSError as exc:
+                last = exc
+                continue
+            try:
+                up.call({"op": "attach", "name": self.pod_name})
+            except (OSError, RuntimeError) as exc:
+                up.close()
+                last = exc
+                continue
+            up.sock.settimeout(None)
+            log.info("upstream to %s:%d re-attached after %d attempt(s)",
+                     self._sched_addr[0], self._sched_addr[1], attempt + 1)
+            return up
+        raise SessionLost(
+            f"token scheduler at {self._sched_addr[0]}:"
+            f"{self._sched_addr[1]} unreachable: {last}")
 
     def _cleanup(self, state: dict) -> None:
         up = state.get("up")

@@ -33,6 +33,7 @@ import threading
 import time
 
 from ..obs import metrics as _obs_metrics
+from ..resilience import faults as _faults
 
 _HDR = struct.Struct(">I")
 MAX_FRAME = 1 << 30
@@ -48,8 +49,23 @@ TRACE_KEY = "_trace"
 #: never part of any op's schema. Absent on lockstep connections.
 SEQ_KEY = "_seq"
 
+#: reserved message key carrying a session-scoped *request id* on a
+#: connection that negotiated the ``"resume"`` feature. Unlike ``_seq``
+#: (which is per-connection and dies with the socket), ``_rid`` is
+#: assigned once per logical request and SURVIVES reconnects: the proxy
+#: records the highest rid it has handled per session plus a bounded
+#: reply cache, so a replayed request is answered from the cache instead
+#: of being executed twice. Stripped by the session layer (the proxy),
+#: not the transport — relays that never negotiate ``resume`` never see
+#: it. See doc/isolation-wire.md § resume token and replay semantics.
+RID_KEY = "_rid"
+
+#: reserved companion to ``_rid``: the highest rid whose reply the
+#: client has observed. Lets the server prune its replay cache.
+ACK_KEY = "_ack"
+
 #: transport features this build can negotiate at register time.
-FEATURES = ("seq",)
+FEATURES = ("resume", "seq")
 
 #: per-connection server credit: requests accepted off the wire but not
 #: yet replied to. Bounds the dispatch queue AND the reply queue, so a
@@ -427,10 +443,14 @@ class Connection:
     concurrently and a slow op never blocks the channel."""
 
     def __init__(self, host: str, port: int, timeout: float | None = None,
-                 trace_id: str = ""):
+                 trace_id: str = "", fault_tag: str = ""):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.trace_id = trace_id
+        #: label for the fault injector's connection-kill filter
+        #: (resilience/faults.py) — lets a test target e.g. only a pod
+        #: manager's upstream connections. Inert without an injector.
+        self.fault_tag = fault_tag
         self._lock = threading.Lock()        # wire write / lockstep RTT
         self._plock = threading.Lock()       # pending table + liveness
         self._cond = threading.Condition()   # shared by all PendingReplys
@@ -508,7 +528,26 @@ class Connection:
         except OSError as e:
             self._break(e)
             raise
+        self._maybe_kill_after_send()
         return rep
+
+    def _maybe_kill_after_send(self, nframes: int = 1) -> None:
+        """Fault-injection hook, called after a request's bytes left.
+        Killing *after* the send models the ambiguous failure — the peer
+        may or may not have handled the request — which is the case
+        reconnect-and-replay exists for. No-op without an injector."""
+        inj = _faults.active()
+        if inj is None:
+            return
+        if inj.should_kill_connection(self.fault_tag, nframes):
+            if self._reader is not None:
+                self._break(ProtocolError("fault injection: connection "
+                                          "killed"))
+            else:
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
 
     def flush(self) -> None:
         """Send every corked frame (no-op when the outbox is empty)."""
@@ -531,6 +570,7 @@ class Connection:
         with self._lock:
             try:
                 send_msg(self.sock, msg, blob)
+                self._maybe_kill_after_send()
                 reply, rblob = recv_msg(self.sock, sink=sink)
             except OSError:
                 # Fail-stop: a timeout or error mid-exchange leaves the
@@ -685,6 +725,22 @@ def serve_framed(host: str, port: int, handle, cleanup=None,
             sock = self.request
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             state: dict = {}
+            with self.server._conn_mu:
+                self.server._conn_socks.add(sock)
+
+            def _disconnect():
+                # Server-initiated kick (migration detaches the old
+                # owner; fault tests simulate crashes): shutting down the
+                # socket unblocks the reader and runs the normal
+                # disconnect path — cleanup semantics identical to the
+                # peer dying.
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+            #: handlers may stash this to sever the connection later
+            state["_disconnect"] = _disconnect
             # SimpleQueue (C-implemented) for the stage handoffs — the
             # per-op cost of a bounded queue.Queue's lock+condition dance
             # is measurable at pipelined small-op rates. Credit (accepted
@@ -758,9 +814,20 @@ def serve_framed(host: str, port: int, handle, cleanup=None,
                     if not batch:
                         continue             # lone shutdown sentinel
                     _INFLIGHT.inc(amount=-float(len(batch)))
+                    inj = _faults.active()
+                    if inj is not None:
+                        delay = inj.writer_delay_s()
+                        if delay:
+                            time.sleep(delay)
                     parts: list = []
                     for reply, rblob in batch:
                         if dead:
+                            continue
+                        if inj is not None and inj.should_drop_reply(
+                                reply.get(SEQ_KEY)):
+                            # lost-reply fault: the request WAS handled;
+                            # credit accounting is untouched (the batch
+                            # length below still counts it)
                             continue
                         try:
                             parts.extend(_frame(reply, rblob))
@@ -805,10 +872,17 @@ def serve_framed(host: str, port: int, handle, cleanup=None,
                 requests.put(None)
                 worker.join()
                 writer.join()
+                with self.server._conn_mu:
+                    self.server._conn_socks.discard(sock)
                 if cleanup is not None:
                     cleanup(state)
 
     server = FramedServer((host, port), Handler)
+    # live per-connection sockets, for hard-crash fault injection (the
+    # proxy's crash() severs every client at once) — and any future
+    # admin-initiated mass disconnect
+    server._conn_mu = threading.Lock()
+    server._conn_socks = set()
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name=f"framed-server-{server.server_address[1]}")
     thread.start()

@@ -25,14 +25,19 @@ Enforcement lives where the reference's lives:
 
 from __future__ import annotations
 
+import os
+import socket
 import threading
 import time
-from collections import deque
+import uuid
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..resilience import faults as _faults
+from ..resilience.journal import SessionJournal
 from ..utils.logger import get_logger
 from . import protocol
 from .protocol import load_array
@@ -42,13 +47,54 @@ log = get_logger("proxy")
 
 IDLE_RELEASE_MS = 10.0
 
+#: how long a detached (resumable) session's state is kept before the
+#: watchdog reclaims it — the client's reconnect budget must fit inside
+DETACH_GRACE_MS = 30_000.0
+
 _KNOWN_OPS = frozenset((
     "register", "put", "put_begin", "put_chunk", "put_commit", "put_abort",
-    "get", "free", "compile", "execute", "usage", "unregister"))
+    "get", "free", "compile", "execute", "usage", "unregister",
+    "drain", "migrate_begin", "migrate_finish", "export_session",
+    "export_buffer", "export_program", "import_session",
+    "import_buffer_begin", "import_buffer_chunk", "import_buffer_commit",
+    "import_program"))
+#: control-plane ops addressed by resume token, not connection identity
+#: (the mover — scheduler/operator tooling — is never a registered
+#: client; holding a session's token IS the capability to move it)
+_ADMIN_OPS = frozenset((
+    "drain", "migrate_begin", "migrate_finish", "export_session",
+    "export_buffer", "export_program", "import_session",
+    "import_buffer_begin", "import_buffer_chunk", "import_buffer_commit",
+    "import_program"))
+#: side-effect-free (or naturally idempotent) ops: a replayed rid whose
+#: reply fell out of the cache — or was never cached because it carries
+#: a blob — is simply re-executed
+_REPLAY_REEXEC = frozenset((
+    "get", "usage", "free", "put_abort", "put_chunk"))
+#: session-mutating ops after which the journal manifest is rewritten
+_JOURNALED_OPS = frozenset((
+    "put", "put_begin", "put_commit", "put_abort", "compile", "execute",
+    "free"))
 _RPC_LAT = obs_metrics.default_registry().histogram(
     "kubeshare_proxy_rpc_latency_seconds",
     "Chip-proxy RPC handling wall time per op (token waits and device "
     "time included).", labels=("op",))
+_OBS = obs_metrics.default_registry()
+_RESUMES = _OBS.counter(
+    "kubeshare_proxy_session_resumes_total",
+    "Sessions re-attached via a resume token after their connection "
+    "died.")
+_DETACHES = _OBS.counter(
+    "kubeshare_proxy_session_detaches_total",
+    "Resumable sessions whose connection died (state parked, awaiting "
+    "resume or grace expiry).")
+_DETACHED = _OBS.gauge(
+    "kubeshare_proxy_sessions_detached",
+    "Resumable sessions currently parked without a connection.")
+_REPLAY_SERVED = _OBS.counter(
+    "kubeshare_proxy_replay_served_total",
+    "Replayed requests answered from the per-session reply cache (or "
+    "re-executed idempotently) instead of being executed twice.")
 
 
 def _now_ms() -> float:
@@ -130,6 +176,36 @@ class _Session:
     #: trace ID propagated by the client at register (protocol TRACE_KEY);
     #: handed to the token scheduler so grant-waits join the pod's timeline
     trace_id: str = ""
+    # -- resilience state (resumable sessions only) ---------------------
+    #: features negotiated at register; frozen for the session's lifetime
+    features: frozenset = frozenset()
+    #: capability to re-attach/migrate this session; empty = classic
+    #: session, dropped with its connection
+    resume_token: str = ""
+    #: a connection currently owns the session (identity stays
+    #: connection-bound between detach and resume)
+    attached: bool = True
+    detached_at: float = 0.0
+    #: set while no connection owns the session; resume waits on it so a
+    #: racing reconnect can't alias the dying connection
+    detach_ev: threading.Event = field(default_factory=threading.Event)
+    migrating: bool = False
+    #: severs the owning connection (installed by the server transport);
+    #: migration and resume takeover use it to kick the old owner
+    disconnect: object = None
+    #: replay state: highest request id handled + bounded blobless reply
+    #: cache, so a replayed request is answered, not re-executed
+    last_rid: int = 0
+    replies: OrderedDict = field(default_factory=OrderedDict)
+    #: staged uploads invalidated by a detach — their bytes are gone and
+    #: their HBM reservation released; a replayed chunk referencing one
+    #: gets a typed refusal telling the client to restart the upload
+    aborted_staging: set = field(default_factory=set)
+    #: exec_id -> (serialized exported program, ncarry): retained for
+    #: journal/export so a restarted or destination proxy can recompile
+    program_blobs: dict = field(default_factory=dict)
+    #: import staging sid -> destination handle (migration transfers)
+    import_handles: dict = field(default_factory=dict)
 
     def fresh_id(self) -> int:
         self.next_id += 1
@@ -195,8 +271,13 @@ class ChipProxy:
     identical code path (the proxy is backend-agnostic by construction).
     """
 
+    #: per-session replay cache entries (blobless replies only)
+    REPLAY_CACHE = 256
+
     def __init__(self, device=None, scheduler: TokenScheduler | None = None,
-                 idle_release_ms: float = IDLE_RELEASE_MS):
+                 idle_release_ms: float = IDLE_RELEASE_MS,
+                 journal_dir: str | None = None,
+                 detach_grace_ms: float = DETACH_GRACE_MS):
         import jax
         self._jax = jax
         self.device = device if device is not None else jax.devices()[0]
@@ -204,7 +285,16 @@ class ChipProxy:
         self.scheduler = (scheduler if scheduler is not None
                           else TokenScheduler(chip=str(self.device)))
         self.idle_release_ms = idle_release_ms
+        self.detach_grace_ms = detach_grace_ms
+        self.journal = SessionJournal(journal_dir)
         self._sessions: dict[str, _Session] = {}
+        self._by_token: dict[str, _Session] = {}
+        #: token -> (host, port) tombstones left by migrate_finish, so a
+        #: reconnecting client is redirected to the destination proxy
+        self._moved: dict[str, tuple[str, int]] = {}
+        self._draining = False
+        self._crashed = False
+        self._recovered = False
         self._slock = threading.Lock()
         # Serializes ALL device interactions (put/get/compile/execute).
         # The chip is single-tenant and its transport is not safe under
@@ -229,6 +319,11 @@ class ChipProxy:
     # -- lifecycle -----------------------------------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> protocol.FramedServer:
+        if self.journal.enabled and not self._recovered:
+            # restore journaled sessions BEFORE the listener exists, so a
+            # reconnecting client never races a half-recovered proxy
+            self._recovered = True
+            self._recover_sessions()
         self._server = protocol.serve_framed(host, port, self._handle_timed,
                                              self._cleanup,
                                              sink=self._blob_sink)
@@ -275,11 +370,15 @@ class ChipProxy:
             except KeyError:
                 raise KeyError(f"unknown client {name!r}") from None
 
-    def _drop_session(self, name: str) -> None:
+    def _drop_session(self, name: str, purge: bool = False) -> None:
         with self._slock:
             sess = self._sessions.pop(name, None)
+            if sess is not None and sess.resume_token:
+                self._by_token.pop(sess.resume_token, None)
         if sess is None:
             return
+        if sess.resume_token and not sess.attached:
+            _DETACHED.inc(amount=-1.0)
         with sess.lock:
             holding, used = sess.holding, sess.used_ms
             sess.holding = False
@@ -291,7 +390,178 @@ class ChipProxy:
         self.scheduler.remove_client(name)
         sess.buffers.clear()
         sess.executables.clear()
+        sess.program_blobs.clear()
+        if purge and sess.resume_token:
+            self.journal.purge(sess.resume_token)
         log.info("client %s dropped (freed %d bytes HBM)", name, sess.hbm_used)
+
+    def _detach_session(self, sess: _Session) -> None:
+        """Connection died but the session holds a resume token: park the
+        state instead of dropping it. Everything tied to the *connection*
+        is released — the token (a parked client must not hold the chip),
+        the fetch cache, and every open staged upload: its window can
+        never complete (partially-landed bytes are garbage), so the
+        staging buffers are GC'd, their HBM reservation released, and the
+        sids remembered as aborted so replayed chunks get a typed refusal
+        instead of silently corrupting a commit."""
+        with sess.lock:
+            holding, used = sess.holding, sess.used_ms
+            sess.holding = False
+        if holding:
+            try:
+                self.scheduler.release(sess.name, used)
+            except Exception:
+                pass
+        with self._slock:
+            for sid, (_total, _raw, charged) in sess.staging.items():
+                sess.hbm_used -= charged
+                sess.aborted_staging.add(sid)
+            sess.staging.clear()
+            while len(sess.aborted_staging) > 256:
+                sess.aborted_staging.pop()
+            sess.fetch_cache = None
+            sess.attached = False
+            sess.detached_at = _now_ms()
+            sess.disconnect = None
+        sess.detach_ev.set()
+        _DETACHES.inc()
+        _DETACHED.inc()
+        self._journal_checkpoint(sess)
+        log.info("client %s detached (%d bytes HBM parked, %d staged "
+                 "uploads aborted)", sess.name, sess.hbm_used,
+                 len(sess.aborted_staging))
+
+    # -- drain / crash -------------------------------------------------------
+
+    def drain(self) -> None:
+        """Stop admitting new sessions and bleed tokens down fast —
+        the precondition for migrating sessions off this chip."""
+        self._draining = True
+        # a draining chip should not let idle holders sit on the token
+        self.idle_release_ms = min(self.idle_release_ms, 2.0)
+        log.info("proxy draining: new sessions refused")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def crash(self) -> None:
+        """Fault-injection hard stop: the listener and every live
+        connection die immediately and NO cleanup runs (``_cleanup`` is
+        short-circuited) — the closest a test can get to ``kill -9``
+        without losing the process. Session recovery must come from the
+        journal alone."""
+        self._crashed = True
+        self._stop.set()
+        srv, self._server = self._server, None
+        if srv is None:
+            return
+        with srv._conn_mu:
+            socks = list(srv._conn_socks)
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        # shutdown() joins the serve_forever loop; do it off-thread so a
+        # worker-thread crash hook (mid-request) cannot deadlock itself
+        threading.Thread(
+            target=lambda: (srv.shutdown(), srv.server_close()),
+            daemon=True).start()
+
+    # -- journal -------------------------------------------------------------
+
+    def _manifest(self, sess: _Session) -> dict:
+        return {
+            "token": sess.resume_token,
+            "name": sess.name,
+            "request": sess.request,
+            "limit": sess.limit,
+            "memory": sess.memory_cap,
+            "features": sorted(sess.features),
+            "trace_id": sess.trace_id,
+            "next_id": sess.next_id,
+            "last_rid": sess.last_rid,
+            "buffers": [{"handle": int(h), "shape": list(b.shape),
+                         "dtype": str(b.dtype), "nbytes": int(b.nbytes)}
+                        for h, b in sess.buffers.items()],
+            "programs": [{"exec_id": int(i), "ncarry": nc}
+                         for i, (_blob, nc) in sess.program_blobs.items()],
+            "staging": sorted(int(s) for s in sess.staging),
+            "aborted": sorted(int(s) for s in sess.aborted_staging),
+            "replies": [[int(r), rep] for r, rep in sess.replies.items()],
+        }
+
+    def _journal_checkpoint(self, sess: _Session) -> None:
+        if sess.resume_token and self.journal.enabled:
+            self.journal.checkpoint(self._manifest(sess))
+
+    def _journal_buffer(self, sess: _Session, handle: int, buf) -> None:
+        if not (sess.resume_token and self.journal.enabled):
+            return
+        with self._dlock:
+            host = np.asarray(buf)
+        self.journal.save_buffer(sess.resume_token, handle, host)
+
+    def _forget_buffer(self, sess: _Session, handle: int):
+        """Drop one buffer (freed or donated): HBM accounting plus the
+        journal sidecar, in one place."""
+        buf = sess.buffers.pop(int(handle), None)
+        if buf is not None:
+            sess.hbm_used -= int(buf.nbytes)
+            if sess.resume_token and self.journal.enabled:
+                self.journal.drop_buffer(sess.resume_token, int(handle))
+        return buf
+
+    def _recover_sessions(self) -> None:
+        for manifest in self.journal.recover():
+            try:
+                self._restore_session(manifest)
+            except Exception as exc:
+                log.warning("journal recovery of session %r failed: %s",
+                            manifest.get("name"), exc)
+
+    def _restore_session(self, m: dict) -> None:
+        name, token = str(m["name"]), str(m["token"])
+        with self._slock:
+            if name in self._sessions:
+                return
+        self.scheduler.add_client(name, float(m["request"]),
+                                  float(m["limit"]))
+        sess = _Session(name, float(m["request"]), float(m["limit"]),
+                        int(m.get("memory", 0)))
+        sess.features = frozenset(m.get("features", ()))
+        sess.resume_token = token
+        sess.trace_id = str(m.get("trace_id", ""))
+        sess.next_id = int(m.get("next_id", 0))
+        sess.last_rid = int(m.get("last_rid", 0))
+        sess.replies = OrderedDict(
+            (int(rid), rep) for rid, rep in m.get("replies", []))
+        # open windows can never complete across a crash: recovered as
+        # aborted, the client restarts those uploads
+        sess.aborted_staging = {int(s) for s in m.get("staging", [])}
+        sess.aborted_staging |= {int(s) for s in m.get("aborted", [])}
+        sess.attached = False
+        sess.detached_at = _now_ms()
+        sess.detach_ev.set()
+        for spec in m.get("buffers", ()):
+            handle = int(spec["handle"])
+            arr = self.journal.load_buffer(token, handle)
+            with self._dlock:
+                dev = self._jax.device_put(arr, self.device)
+            sess.buffers[handle] = dev
+            sess.hbm_used += int(dev.nbytes)
+        for spec in m.get("programs", ()):
+            blob = self.journal.load_program(token, int(spec["exec_id"]))
+            self._install_program(sess, blob, spec.get("ncarry"),
+                                  exec_id=int(spec["exec_id"]))
+        with self._slock:
+            self._sessions[name] = sess
+            self._by_token[token] = sess
+        _DETACHED.inc()
+        log.info("recovered session %s from journal (%d buffers, %d "
+                 "programs, last_rid=%d)", name, len(sess.buffers),
+                 len(sess.program_blobs), sess.last_rid)
 
     # -- HBM accounting ------------------------------------------------------
 
@@ -383,6 +653,15 @@ class ChipProxy:
                         self.scheduler.release(sess.name, used)
                     except Exception:  # raced a drop
                         pass
+            # reclaim detached sessions nobody resumed within the grace
+            # window — a crashed-for-good client must not park HBM forever
+            for sess in sessions:
+                if (sess.resume_token and not sess.attached
+                        and not sess.migrating
+                        and now - sess.detached_at >= self.detach_grace_ms):
+                    log.info("detached session %s expired after %.0f ms",
+                             sess.name, now - sess.detached_at)
+                    self._drop_session(sess.name, purge=True)
 
     # -- protocol ------------------------------------------------------------
 
@@ -395,13 +674,20 @@ class ChipProxy:
         unknown staging id, out-of-range offset) returns None; the payload
         then lands in a scratch buffer and the worker raises the proper
         error with full context."""
-        if msg.get("op") != "put_chunk":
+        op = msg.get("op")
+        if op == "import_buffer_chunk":
+            # migration transfers land the same way; the mover addresses
+            # the destination session by token, not connection identity
+            with self._slock:
+                sess = self._by_token.get(str(msg.get("token", "")))
+        elif op == "put_chunk":
+            name = state.get("name")
+            if not name:
+                return None
+            with self._slock:
+                sess = self._sessions.get(name)
+        else:
             return None
-        name = state.get("name")
-        if not name:
-            return None
-        with self._slock:
-            sess = self._sessions.get(name)
         if sess is None:
             return None
         try:
@@ -430,27 +716,9 @@ class ChipProxy:
     def _handle(self, req: dict, state: dict) -> dict:
         op = req.get("op")
         if op == "register":
-            if state.get("name"):
-                # A second register would orphan the first session at
-                # disconnect (cleanup drops only state["name"]).
-                raise ValueError(
-                    f"connection already registered as {state['name']!r}")
-            name = req["name"]
-            sess = self._register(name, float(req["request"]),
-                                  float(req["limit"]),
-                                  int(req.get("memory", 0)))
-            sess.trace_id = state.get("trace_id", "")
-            state["name"] = name
-            reply = {"ok": True, "platforms": [self.platform],
-                     "device": str(self.device)}
-            if "features" in req:
-                # Feature negotiation: granted = requested ∩ supported.
-                # The key is echoed ONLY when the client asked — an
-                # un-negotiating (old-protocol) peer gets the reply shape
-                # it has always gotten, byte-for-byte.
-                reply["features"] = protocol.negotiate_features(
-                    req.get("features") or ())
-            return reply
+            return self._handle_register(req, state)
+        if op in _ADMIN_OPS:
+            return self._handle_admin(op, req, state)
 
         # Identity is connection-bound: a session is only reachable from the
         # connection that registered it (a client must not be able to burn
@@ -460,6 +728,285 @@ class ChipProxy:
             raise PermissionError("not registered on this connection")
         sess = self._session(name)
 
+        rid = req.pop(protocol.RID_KEY, None)
+        ack = req.pop(protocol.ACK_KEY, None)
+        if ack is not None:
+            self._prune_replies(sess, int(ack))
+        if rid is None:
+            return self._dispatch(op, req, sess, state)
+        # Resumed-session replay protocol: a rid at or below the handled
+        # watermark was (possibly) executed already — answer from the
+        # reply cache, or re-execute only when the op is idempotent. A
+        # fresh rid executes normally, with errors captured IN-BAND so
+        # the failure outcome itself is replayable (a lost error reply
+        # must not turn into a second execution on retry).
+        rid = int(rid)
+        if rid <= sess.last_rid:
+            cached = sess.replies.get(rid)
+            if cached is not None:
+                _REPLAY_SERVED.inc()
+                return dict(cached)
+            if op in _REPLAY_REEXEC:
+                _REPLAY_SERVED.inc()
+                return self._dispatch(op, req, sess, state)
+            return {"ok": False,
+                    "error": f"ReplayError: request {rid} is outside "
+                             f"the replay window"}
+        try:
+            reply = self._dispatch(op, req, sess, state)
+        except Exception as e:
+            reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        sess.last_rid = max(sess.last_rid, rid)
+        if state.get("reply_blob") is None:
+            # blob-bearing replies (sliced get) are never cached — the op
+            # is idempotent and caching would pin payload bytes
+            sess.replies[rid] = dict(reply)
+            while len(sess.replies) > self.REPLAY_CACHE:
+                sess.replies.popitem(last=False)
+        if op in _JOURNALED_OPS:
+            self._journal_checkpoint(sess)
+        return reply
+
+    def _prune_replies(self, sess: _Session, ack: int) -> None:
+        while sess.replies:
+            rid = next(iter(sess.replies))
+            if rid > ack:
+                break
+            sess.replies.popitem(last=False)
+
+    def _handle_register(self, req: dict, state: dict) -> dict:
+        if "resume" in req:
+            return self._resume(str(req["resume"]), state)
+        if state.get("name"):
+            # A second register would orphan the first session at
+            # disconnect (cleanup drops only state["name"]).
+            raise ValueError(
+                f"connection already registered as {state['name']!r}")
+        if self._draining:
+            raise RuntimeError("proxy is draining; new sessions refused")
+        name = req["name"]
+        sess = self._register(name, float(req["request"]),
+                              float(req["limit"]),
+                              int(req.get("memory", 0)))
+        sess.trace_id = state.get("trace_id", "")
+        sess.disconnect = state.get("_disconnect")
+        state["name"] = name
+        reply = {"ok": True, "platforms": [self.platform],
+                 "device": str(self.device)}
+        if "features" in req:
+            # Feature negotiation: granted = requested ∩ supported.
+            # The key is echoed ONLY when the client asked — an
+            # un-negotiating (old-protocol) peer gets the reply shape
+            # it has always gotten, byte-for-byte.
+            granted = protocol.negotiate_features(req.get("features") or ())
+            sess.features = frozenset(granted)
+            reply["features"] = granted
+            if "resume" in sess.features:
+                token = uuid.uuid4().hex
+                sess.resume_token = token
+                with self._slock:
+                    self._by_token[token] = sess
+                reply["resume"] = token
+                self._journal_checkpoint(sess)
+        return reply
+
+    def _resume(self, token: str, state: dict) -> dict:
+        """Re-attach a parked session to this (new) connection. The
+        token is the capability; the old connection — if the kernel has
+        not reaped it yet — is kicked and its detach awaited, so exactly
+        one connection ever owns the session."""
+        if state.get("name"):
+            raise ValueError(
+                f"connection already registered as {state['name']!r}")
+        with self._slock:
+            moved = self._moved.get(token)
+            sess = self._by_token.get(token)
+        if moved is not None:
+            return {"ok": True, "moved": [moved[0], moved[1]]}
+        if sess is None:
+            raise KeyError("unknown resume token")
+        if sess.migrating:
+            raise RuntimeError("session is migrating; retry")
+        if sess.attached:
+            kick = sess.disconnect
+            if kick is not None:
+                try:
+                    kick()
+                except Exception:
+                    pass
+            if not sess.detach_ev.wait(timeout=5.0):
+                raise RuntimeError("session still attached")
+            if sess.migrating:
+                raise RuntimeError("session is migrating; retry")
+        with self._slock:
+            sess.attached = True
+            sess.detach_ev.clear()
+            sess.disconnect = state.get("_disconnect")
+            sess.trace_id = state.get("trace_id", sess.trace_id)
+        state["name"] = sess.name
+        _RESUMES.inc()
+        _DETACHED.inc(amount=-1.0)
+        log.info("session %s resumed (last_rid=%d)", sess.name,
+                 sess.last_rid)
+        return {"ok": True, "platforms": [self.platform],
+                "device": str(self.device),
+                "features": sorted(sess.features), "resume": token,
+                "resumed": True, "last_rid": sess.last_rid}
+
+    def _admin_session(self, req: dict) -> _Session:
+        token = str(req.get("token", ""))
+        with self._slock:
+            sess = self._by_token.get(token)
+        if sess is None:
+            raise KeyError("unknown resume token")
+        return sess
+
+    def _handle_admin(self, op, req: dict, state: dict) -> dict:
+        """Control-plane ops for drain + live migration. These arrive on
+        an UNREGISTERED connection (the mover is scheduler/operator
+        tooling, not a client); the resume token is the capability."""
+        if op == "drain":
+            self.drain()
+            return {"ok": True}
+
+        if op == "import_session":
+            if self._draining:
+                raise RuntimeError("proxy is draining; imports refused")
+            m = dict(req["manifest"])
+            name, token = str(m["name"]), str(m["token"])
+            with self._slock:
+                if name in self._sessions:
+                    raise ValueError(f"session {name!r} already exists")
+                if token in self._by_token:
+                    raise ValueError("resume token already present")
+            self.scheduler.add_client(name, float(m["request"]),
+                                      float(m["limit"]))
+            sess = _Session(name, float(m["request"]), float(m["limit"]),
+                            int(m.get("memory", 0)))
+            sess.features = frozenset(m.get("features", ()))
+            sess.resume_token = token
+            sess.trace_id = str(m.get("trace_id", ""))
+            sess.next_id = int(m.get("next_id", 0))
+            sess.last_rid = int(m.get("last_rid", 0))
+            sess.replies = OrderedDict(
+                (int(rid), rep) for rid, rep in m.get("replies", []))
+            sess.aborted_staging = {int(s) for s in m.get("staging", [])}
+            sess.aborted_staging |= {int(s) for s in m.get("aborted", [])}
+            sess.attached = False
+            sess.detached_at = _now_ms()
+            sess.detach_ev.set()
+            with self._slock:
+                self._sessions[name] = sess
+                self._by_token[token] = sess
+            _DETACHED.inc()
+            self._journal_checkpoint(sess)
+            return {"ok": True}
+
+        sess = self._admin_session(req)
+
+        if op == "migrate_begin":
+            # freeze the session: resumes get a retryable refusal while
+            # its bytes are in flight, and the old connection (if any) is
+            # kicked so no request mutates state under the export
+            sess.migrating = True
+            if sess.attached:
+                kick = sess.disconnect
+                if kick is not None:
+                    try:
+                        kick()
+                    except Exception:
+                        pass
+                if not sess.detach_ev.wait(timeout=5.0):
+                    sess.migrating = False
+                    raise RuntimeError("session still attached; cannot "
+                                       "migrate")
+            return {"ok": True}
+
+        if op == "export_session":
+            return {"ok": True, "manifest": self._manifest(sess)}
+
+        if op == "export_buffer":
+            handle = int(req["handle"])
+            buf = sess.buffers[handle]
+            if sess.fetch_cache is None or sess.fetch_cache[0] != handle:
+                with self._dlock:
+                    parts = protocol.dump_array_parts(buf)
+                sess.fetch_cache = (handle, parts,
+                                    protocol.buffers_nbytes(parts))
+            _, parts, total = sess.fetch_cache
+            off, length = int(req["offset"]), int(req["length"])
+            if off < 0 or length <= 0:
+                raise ValueError(f"bad slice [{off}, +{length})")
+            if off + length >= total:
+                sess.fetch_cache = None
+            state["reply_blob"] = protocol.slice_buffers(parts, off, length)
+            return {"ok": True, "total": total}
+
+        if op == "export_program":
+            blob, ncarry = sess.program_blobs[int(req["exec_id"])]
+            state["reply_blob"] = [blob]
+            return {"ok": True, "ncarry": ncarry}
+
+        if op == "import_buffer_begin":
+            total = int(req["nbytes"])
+            if not 0 < total <= (64 << 30):
+                raise ValueError(f"bad staged size {total}")
+            charged = max(total - 4096, 0)
+            self._charge(sess, charged)
+            sid = sess.fresh_id()
+            sess.staging[sid] = (total, bytearray(total), charged)
+            sess.import_handles[sid] = int(req["handle"])
+            return {"ok": True, "staging": sid}
+
+        if op == "import_buffer_chunk":
+            total, raw, _charged = sess.staging[int(req["staging"])]
+            if state.get("blob_sunk"):
+                return {"ok": True}
+            blob = state["blob"] or b""
+            off = int(req["offset"])
+            if off < 0 or off + len(blob) > total:
+                raise ValueError(
+                    f"chunk [{off}, {off + len(blob)}) outside staged "
+                    f"{total}")
+            raw[off:off + len(blob)] = blob
+            return {"ok": True}
+
+        if op == "import_buffer_commit":
+            sid = int(req["staging"])
+            total, raw, charged = sess.staging.pop(sid)
+            handle = sess.import_handles.pop(sid)
+            sess.hbm_used -= charged
+            arr = load_array(raw, writable=False)
+            self._charge(sess, arr.nbytes)
+            sess.hbm_used -= arr.nbytes
+            with self._dlock:
+                buf = self._jax.device_put(arr, self.device)
+            self._charge(sess, int(buf.nbytes))
+            sess.buffers[handle] = buf
+            self._journal_buffer(sess, handle, buf)
+            self._journal_checkpoint(sess)
+            return {"ok": True}
+
+        if op == "import_program":
+            ncarry = req.get("ncarry")
+            self._install_program(sess, state["blob"], ncarry,
+                                  exec_id=int(req["exec_id"]))
+            self._journal_checkpoint(sess)
+            return {"ok": True}
+
+        if op == "migrate_finish":
+            host, port = req["moved"]
+            token = sess.resume_token
+            with self._slock:
+                self._moved[token] = (str(host), int(port))
+            self._drop_session(sess.name, purge=True)
+            log.info("session %s migrated to %s:%d", sess.name,
+                     str(host), int(port))
+            return {"ok": True}
+
+        return {"ok": False, "error": f"unknown admin op {op!r}"}
+
+    def _dispatch(self, op, req: dict, sess: _Session, state: dict) -> dict:
         if op == "put":
             return self._put_array(sess,
                                    load_array(state["blob"],
@@ -488,7 +1035,16 @@ class ChipProxy:
             return {"ok": True, "staging": sid}
 
         if op == "put_chunk":
-            total, raw, _charged = sess.staging[int(req["staging"])]
+            inj = _faults.active()
+            if inj is not None and inj.should_crash_proxy():
+                self.crash()
+                raise RuntimeError("fault injection: proxy crashed")
+            sid = int(req["staging"])
+            if sid in sess.aborted_staging:
+                raise RuntimeError(
+                    f"staging {sid} invalidated by disconnect; "
+                    f"restart upload")
+            total, raw, _charged = sess.staging[sid]
             if state.get("blob_sunk"):
                 # the connection reader already received the payload
                 # straight into `raw` (see _blob_sink) — nothing to copy
@@ -502,7 +1058,12 @@ class ChipProxy:
             return {"ok": True}
 
         if op == "put_commit":
-            total, raw, charged = sess.staging.pop(int(req["staging"]))
+            sid = int(req["staging"])
+            if sid in sess.aborted_staging:
+                raise RuntimeError(
+                    f"staging {sid} invalidated by disconnect; "
+                    f"restart upload")
+            total, raw, charged = sess.staging.pop(sid)
             # the put_begin reservation hands over to the real device
             # charge taken by _put_array
             sess.hbm_used -= charged
@@ -511,7 +1072,9 @@ class ChipProxy:
             return self._put_array(sess, load_array(raw, writable=False))
 
         if op == "put_abort":
-            entry = sess.staging.pop(int(req["staging"]), None)
+            sid = int(req["staging"])
+            sess.aborted_staging.discard(sid)
+            entry = sess.staging.pop(sid, None)
             if entry is not None:
                 sess.hbm_used -= entry[2]
             return {"ok": True}
@@ -557,9 +1120,7 @@ class ChipProxy:
 
         if op == "free":
             for handle in req["handles"]:
-                buf = sess.buffers.pop(int(handle), None)
-                if buf is not None:
-                    sess.hbm_used -= int(buf.nbytes)
+                self._forget_buffer(sess, int(handle))
                 if sess.fetch_cache and sess.fetch_cache[0] == int(handle):
                     sess.fetch_cache = None
             return {"ok": True}
@@ -579,7 +1140,8 @@ class ChipProxy:
                     "exec_ms_total": sess.exec_ms_total}
 
         if op == "unregister":
-            self._drop_session(sess.name)
+            # clean exit: the durable record must not outlive the session
+            self._drop_session(sess.name, purge=True)
             state.pop("name", None)
             return {"ok": True}
 
@@ -602,11 +1164,23 @@ class ChipProxy:
             raise
         handle = sess.fresh_id()
         sess.buffers[handle] = buf
+        self._journal_buffer(sess, handle, buf)
         return {"ok": True, "handle": handle,
                 "shape": list(buf.shape), "dtype": str(buf.dtype)}
 
     def _compile(self, sess: _Session, blob: bytes,
                  ncarry: int | None = None) -> dict:
+        exec_id, out_meta, out_nbytes = self._install_program(
+            sess, blob, ncarry)
+        return {"ok": True, "exec_id": exec_id,
+                "out_meta": out_meta, "out_nbytes": out_nbytes}
+
+    def _install_program(self, sess: _Session, blob: bytes,
+                         ncarry: int | None = None,
+                         exec_id: int | None = None):
+        """Deserialize + register an exported program. Shared by compile
+        (fresh exec_id), migration import and journal recovery (caller
+        pins the original exec_id so client-held ids stay valid)."""
         import hashlib
 
         from jax import export
@@ -645,13 +1219,17 @@ class ChipProxy:
         nonempty = [(n, i) for i, n in enumerate(out_sizes) if n > 0]
         sync_out = ((-1, False) if not nonempty
                     else (min(nonempty)[1], min(nonempty)[0] > 65536))
-        exec_id = sess.fresh_id()
+        if exec_id is None:
+            exec_id = sess.fresh_id()
         sess.executables[exec_id] = _Executable(
             exec_id, exported.call, in_specs, out_nbytes, out_meta,
             prog=prog, ncarry=None if ncarry is None else int(ncarry),
             in_meta=in_meta, sync_out=sync_out)
-        return {"ok": True, "exec_id": exec_id,
-                "out_meta": out_meta, "out_nbytes": out_nbytes}
+        sess.program_blobs[exec_id] = (
+            bytes(blob), None if ncarry is None else int(ncarry))
+        if sess.resume_token:
+            self.journal.save_program(sess.resume_token, exec_id, blob)
+        return exec_id, out_meta, out_nbytes
 
     def _single_fn(self, exe: _Executable):
         """AOT-compile the single-call program (lazily, OUTSIDE the token
@@ -829,9 +1407,7 @@ class ChipProxy:
                 # errors on the next dispatch instead.
                 consumed = [int(h) for h in req["args"][:exe.ncarry]]
                 for handle in consumed:
-                    buf = sess.buffers.pop(handle, None)
-                    if buf is not None:
-                        sess.hbm_used -= int(buf.nbytes)
+                    self._forget_buffer(sess, handle)
                 raise RuntimeError(
                     f"loop execution failed and its donated carry was "
                     f"consumed (handles {consumed} freed); re-put the "
@@ -854,10 +1430,9 @@ class ChipProxy:
             handle = sess.fresh_id()
             sess.buffers[handle] = out
             handles.append(handle)
+            self._journal_buffer(sess, handle, out)
         for handle in donate:
-            buf = sess.buffers.pop(handle, None)
-            if buf is not None:
-                sess.hbm_used -= int(buf.nbytes)
+            self._forget_buffer(sess, handle)
         rep = {"ok": True, "handles": handles}
         if repeat != 1 or int(req.get("repeat", 1)) != 1:
             # only loop dispatches consume the echoed clamp; plain executes
@@ -960,9 +1535,7 @@ class ChipProxy:
             if bursts == 0:
                 # the client's carry handles were donated into burst 0
                 for handle in donate:
-                    buf = sess.buffers.pop(handle, None)
-                    if buf is not None:
-                        sess.hbm_used -= int(buf.nbytes)
+                    self._forget_buffer(sess, handle)
             else:
                 # the previous burst's outputs (carry consumed by
                 # donation, intermediate aux dropped) release their charge
@@ -979,6 +1552,7 @@ class ChipProxy:
             handle = sess.fresh_id()
             sess.buffers[handle] = out
             handles.append(handle)
+            self._journal_buffer(sess, handle, out)
         # repeat = total steps run; burst = the per-burst clamp the
         # token-gated cost model converged on (the quantity
         # steady_state_burst reports)
@@ -991,9 +1565,7 @@ class ChipProxy:
         carry handles (burst 0 donated them) and the previous burst's
         floating output charge."""
         for handle in donate:
-            buf = sess.buffers.pop(handle, None)
-            if buf is not None:
-                sess.hbm_used -= int(buf.nbytes)
+            self._forget_buffer(sess, handle)
         if bursts > 0:
             sess.hbm_used -= exe.out_nbytes
 
@@ -1047,8 +1619,22 @@ class ChipProxy:
         return list(outs)
 
     def _cleanup(self, state: dict) -> None:
+        if self._crashed:
+            # fault-injected hard stop: no graceful teardown — recovery
+            # must come from the journal, exactly as after a real crash
+            return
         name = state.get("name")
-        if name:
+        if not name:
+            return
+        with self._slock:
+            sess = self._sessions.get(name)
+        if sess is None:
+            return
+        if sess.resume_token:
+            # resumable session: park it for the grace window instead of
+            # dropping — the client is (probably) already re-dialing
+            self._detach_session(sess)
+        else:
             self._drop_session(name)
 
 
@@ -1076,15 +1662,24 @@ def main(argv=None) -> None:
                         help="force a JAX platform (e.g. 'cpu'); needed "
                              "because the image config pins the platform "
                              "list regardless of JAX_PLATFORMS")
+    parser.add_argument("--journal-dir",
+                        default=os.environ.get("KUBESHARE_JOURNAL_DIR", ""),
+                        help="directory for the durable session journal; "
+                             "empty disables on-disk durability")
     args = parser.parse_args(argv)
 
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
 
+    inj = _faults.from_env()
+    if inj is not None:
+        _faults.install(inj)
+
     sched = TokenScheduler(window_ms=args.window, base_quota_ms=args.base_quota,
                            min_quota_ms=args.min_quota)
-    proxy = ChipProxy(scheduler=sched)
+    proxy = ChipProxy(scheduler=sched,
+                      journal_dir=args.journal_dir or None)
     server = proxy.serve(args.host, args.port)
     token_server = None
     token_port = ""

@@ -1,0 +1,38 @@
+"""Resilience plane: durable sessions, reconnect-and-replay, migration.
+
+Submodules:
+
+- :mod:`.faults` — deterministic, seedable fault injection (the only
+  submodule the transport itself imports; it has no isolation imports,
+  so the dependency edge stays one-directional);
+- :mod:`.journal` — per-session durable state on the proxy;
+- :mod:`.reconnect` — client-side transparent reconnect-and-replay
+  (:class:`ResilientConnection`, :class:`SessionLost`);
+- :mod:`.migrate` — drain + proxy-to-proxy live session migration.
+
+Re-exports are lazy: ``reconnect`` imports ``isolation.protocol``, which
+imports ``resilience.faults`` — an eager import here would cycle.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "FaultSpec": ".faults",
+    "Injector": ".faults",
+    "SessionLost": ".reconnect",
+    "ReconnectPolicy": ".reconnect",
+    "ResilientConnection": ".reconnect",
+    "SessionJournal": ".journal",
+    "migrate_session": ".migrate",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)

@@ -1,0 +1,183 @@
+"""Deterministic, seedable fault injection for the isolation transport.
+
+The resilience plane's claims ("futures never see the failure", "the
+journal survives a proxy crash") are only testable if failures can be
+produced *on demand and reproducibly*. This module is that switchboard:
+a process installs one :class:`Injector` (explicitly in tests, or from
+``KUBESHARE_FAULTS`` in deployments running a fault drill) and the
+transport/proxy hooks consult it at well-defined points:
+
+- ``kill_conn_after_frames=N`` — the Nth frame *sent* by a matching
+  client :class:`~..isolation.protocol.Connection` breaks the connection
+  immediately after the bytes leave (the request may or may not have
+  been handled — exactly the ambiguity replay must resolve);
+- ``drop_reply_seq=K`` — the server writer silently discards the reply
+  tagged ``_seq == K`` (once). Credit accounting is untouched, so this
+  models a lost reply, not a wedged server;
+- ``crash_proxy_after_chunks=N`` — the Nth ``put_chunk`` handled by the
+  proxy hard-crashes it (listener + every live connection die, no
+  cleanup runs — the journal's recovery path is all that's left);
+- ``delay_writer_ms=D`` — every server write batch sleeps first, for
+  shaking out timing-dependent window/credit bugs.
+
+Injectors hold no references into the transport (this module imports
+nothing from ``isolation`` — the dependency points the other way), and
+every decision is made under a lock from seeded state, so a fault matrix
+run is reproducible frame-for-frame.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """What to inject. Zero/empty fields are inert."""
+
+    #: break a client connection right after its Nth sent frame (1-based;
+    #: 0 disables). Counted across all matching connections.
+    kill_conn_after_frames: int = 0
+    #: only connections whose ``fault_tag`` equals this are counted for
+    #: ``kill_conn_after_frames``; empty matches every tagged-or-not
+    #: connection.
+    kill_conn_tag: str = ""
+    #: fire the connection kill this many times (a reconnecting client
+    #: can be killed again on its replacement connection).
+    kill_conn_repeat: int = 1
+    #: server writer drops the reply whose ``_seq`` equals this (once;
+    #: 0 disables).
+    drop_reply_seq: int = 0
+    #: proxy hard-crashes on its Nth handled ``put_chunk`` (0 disables).
+    crash_proxy_after_chunks: int = 0
+    #: every server write batch sleeps this long first (0 disables).
+    delay_writer_ms: float = 0.0
+    #: seed for any randomized decision; fixed default keeps unseeded
+    #: runs reproducible too.
+    seed: int = 0
+
+
+class Injector:
+    """One process-wide fault decision engine over a :class:`FaultSpec`.
+
+    All counters live here (not in the transport), guarded by one lock:
+    the decisions are a pure function of the spec, the seed, and the
+    order of hook calls — rerunning the same workload replays the same
+    faults.
+    """
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self._mu = threading.Lock()
+        self._rng = random.Random(spec.seed)
+        self._frames = 0
+        self._kills = 0
+        self._chunks = 0
+        self._dropped = False
+
+    # -- client connection: frames sent ---------------------------------
+
+    def should_kill_connection(self, tag: str, nframes: int) -> bool:
+        """Called after a client connection wrote ``nframes`` frames.
+        True → the caller must break the connection now."""
+        spec = self.spec
+        if not spec.kill_conn_after_frames:
+            return False
+        if spec.kill_conn_tag and tag != spec.kill_conn_tag:
+            return False
+        with self._mu:
+            if self._kills >= spec.kill_conn_repeat:
+                return False
+            before = self._frames
+            self._frames += int(nframes)
+            # fire when the cumulative count crosses the threshold;
+            # reset the frame counter so repeat kills need N more frames
+            if (before < spec.kill_conn_after_frames
+                    <= self._frames):
+                self._kills += 1
+                self._frames = 0
+                return True
+            return False
+
+    # -- server writer ---------------------------------------------------
+
+    def should_drop_reply(self, seq) -> bool:
+        spec = self.spec
+        if not spec.drop_reply_seq or seq is None:
+            return False
+        with self._mu:
+            if self._dropped:
+                return False
+            if int(seq) == spec.drop_reply_seq:
+                self._dropped = True
+                return True
+            return False
+
+    def writer_delay_s(self) -> float:
+        return max(self.spec.delay_writer_ms, 0.0) / 1000.0
+
+    # -- proxy worker ----------------------------------------------------
+
+    def should_crash_proxy(self) -> bool:
+        """Called per handled ``put_chunk``; True exactly once, on the
+        Nth call."""
+        spec = self.spec
+        if not spec.crash_proxy_after_chunks:
+            return False
+        with self._mu:
+            self._chunks += 1
+            return self._chunks == spec.crash_proxy_after_chunks
+
+
+_active: Injector | None = None
+_install_mu = threading.Lock()
+
+
+def install(injector: Injector | None) -> None:
+    """Install (or clear, with None) the process-wide injector."""
+    global _active
+    with _install_mu:
+        _active = injector
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def active() -> Injector | None:
+    """The installed injector, or None. The hot-path check is one global
+    read — with no injector installed the hooks cost nothing measurable."""
+    return _active
+
+
+def from_env(environ=None) -> Injector | None:
+    """Build an injector from ``KUBESHARE_FAULTS`` (comma-separated
+    ``key=value`` pairs matching :class:`FaultSpec` fields, e.g.
+    ``kill_conn_after_frames=5,drop_reply_seq=3``) and
+    ``KUBESHARE_FAULT_SEED``. Returns None when unset."""
+    env = os.environ if environ is None else environ
+    raw = env.get("KUBESHARE_FAULTS", "").strip()
+    if not raw:
+        return None
+    kwargs: dict = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        key, _, value = item.partition("=")
+        key = key.strip()
+        if key == "kill_conn_tag":
+            kwargs[key] = value.strip()
+        elif key == "delay_writer_ms":
+            kwargs[key] = float(value)
+        elif key in ("kill_conn_after_frames", "kill_conn_repeat",
+                     "drop_reply_seq", "crash_proxy_after_chunks", "seed"):
+            kwargs[key] = int(value)
+        else:
+            raise ValueError(f"unknown fault field {key!r}")
+    if "seed" not in kwargs:
+        kwargs["seed"] = int(env.get("KUBESHARE_FAULT_SEED", "0"))
+    return Injector(FaultSpec(**kwargs))

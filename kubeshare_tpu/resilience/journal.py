@@ -1,0 +1,221 @@
+"""Durable per-session state for the chip proxy.
+
+The proxy keeps every session's recoverable state in memory (that IS the
+session); this journal is the optional on-disk mirror that survives a
+proxy crash. One JSON manifest per session (keyed by its resume token)
+plus sidecar files for the bulky parts:
+
+```
+<dir>/<token>.json               # manifest (atomic tmp+rename)
+<dir>/<token>.buf<handle>.npy    # one per live device buffer
+<dir>/<token>.prog<exec_id>.bin  # serialized exported program
+```
+
+The manifest holds the cheap-but-critical session metadata: identity
+(name/request/limit/memory cap), negotiated features, the replay state
+(``last_rid`` + the bounded blobless reply cache), id-allocator position,
+and which staged uploads were open (recovered as *aborted* — a crash can
+never complete a half-landed window). Buffers and program blobs ride as
+sidecars so a manifest rewrite never re-serializes gigabytes.
+
+With ``dirpath=None`` every method is a no-op — the in-memory journal is
+the session itself, and the proxy pays nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from ..obs import metrics as obs_metrics
+from ..utils.logger import get_logger
+
+log = get_logger("journal")
+
+_JOURNAL_BYTES = obs_metrics.default_registry().gauge(
+    "kubeshare_proxy_journal_bytes",
+    "Total on-disk size of the proxy's session journal (manifests + "
+    "buffer/program sidecars).")
+
+
+class SessionJournal:
+    """On-disk session journal. All methods are best-effort by contract:
+    a journal write failure must degrade durability, never availability
+    (the live session is untouched), so errors are logged and swallowed —
+    except in :meth:`recover`, where a corrupt manifest is skipped."""
+
+    def __init__(self, dirpath: str | None = None):
+        self.dirpath = dirpath
+        self._mu = threading.Lock()
+        if dirpath:
+            os.makedirs(dirpath, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dirpath)
+
+    # -- paths -----------------------------------------------------------
+
+    def _manifest_path(self, token: str) -> str:
+        return os.path.join(self.dirpath, f"{token}.json")
+
+    def _buffer_path(self, token: str, handle: int) -> str:
+        return os.path.join(self.dirpath, f"{token}.buf{int(handle)}.npy")
+
+    def _program_path(self, token: str, exec_id: int) -> str:
+        return os.path.join(self.dirpath, f"{token}.prog{int(exec_id)}.bin")
+
+    # -- writes ----------------------------------------------------------
+
+    def checkpoint(self, manifest: dict) -> None:
+        """Write a session's manifest atomically (tmp + rename: a crash
+        mid-write leaves the previous manifest intact, never a torn one).
+        """
+        if not self.enabled:
+            return
+        token = manifest["token"]
+        path = self._manifest_path(token)
+        tmp = path + ".tmp"
+        try:
+            with self._mu:
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("journal checkpoint for %s failed: %s", token, exc)
+        self._update_size()
+
+    def save_buffer(self, token: str, handle: int, array) -> None:
+        if not self.enabled:
+            return
+        import numpy as np
+        path = self._buffer_path(token, handle)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.save(f, np.asarray(array), allow_pickle=False)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("journal save_buffer %s/%d failed: %s",
+                        token, handle, exc)
+        self._update_size()
+
+    def drop_buffer(self, token: str, handle: int) -> None:
+        if not self.enabled:
+            return
+        try:
+            os.unlink(self._buffer_path(token, handle))
+        except OSError:
+            pass
+        self._update_size()
+
+    def save_program(self, token: str, exec_id: int, blob) -> None:
+        if not self.enabled:
+            return
+        path = self._program_path(token, exec_id)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(bytes(blob))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("journal save_program %s/%d failed: %s",
+                        token, exec_id, exc)
+        self._update_size()
+
+    def purge(self, token: str) -> None:
+        """Remove every trace of a session (dropped, migrated away, or
+        grace-expired)."""
+        if not self.enabled:
+            return
+        try:
+            for name in os.listdir(self.dirpath):
+                if name.startswith(f"{token}."):
+                    try:
+                        os.unlink(os.path.join(self.dirpath, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        self._update_size()
+
+    # -- reads -----------------------------------------------------------
+
+    def load_buffer(self, token: str, handle: int):
+        import numpy as np
+        return np.load(self._buffer_path(token, handle), allow_pickle=False)
+
+    def load_program(self, token: str, exec_id: int) -> bytes:
+        with open(self._program_path(token, exec_id), "rb") as f:
+            return f.read()
+
+    def recover(self) -> list[dict]:
+        """Manifests of every journaled session, for proxy restart.
+        Corrupt manifests are skipped with a warning (one bad session
+        must not block the chip from coming back); orphan sidecars —
+        files no surviving manifest references — are deleted."""
+        if not self.enabled:
+            return []
+        manifests: list[dict] = []
+        referenced: set[str] = set()
+        try:
+            names = sorted(os.listdir(self.dirpath))
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.dirpath, name)
+            try:
+                with open(path) as f:
+                    manifest = json.load(f)
+                token = manifest["token"]
+            except (OSError, ValueError, KeyError) as exc:
+                log.warning("skipping corrupt journal manifest %s: %s",
+                            name, exc)
+                continue
+            manifests.append(manifest)
+            referenced.add(f"{token}.json")
+            for buf in manifest.get("buffers", ()):
+                referenced.add(
+                    os.path.basename(
+                        self._buffer_path(token, buf["handle"])))
+            for prog in manifest.get("programs", ()):
+                referenced.add(
+                    os.path.basename(
+                        self._program_path(token, prog["exec_id"])))
+        for name in names:
+            orphan = (name.endswith(".tmp")
+                      or (not name.endswith(".json")
+                          and name not in referenced))
+            if orphan:
+                try:
+                    os.unlink(os.path.join(self.dirpath, name))
+                except OSError:
+                    pass
+        self._update_size()
+        return manifests
+
+    # -- metrics ---------------------------------------------------------
+
+    def _update_size(self) -> None:
+        if not self.enabled:
+            return
+        total = 0
+        try:
+            for name in os.listdir(self.dirpath):
+                try:
+                    total += os.path.getsize(
+                        os.path.join(self.dirpath, name))
+                except OSError:
+                    pass
+        except OSError:
+            return
+        _JOURNAL_BYTES.set(value=float(total))

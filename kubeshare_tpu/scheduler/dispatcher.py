@@ -402,6 +402,39 @@ class Dispatcher:
         with self._cond:
             return [dict(v) for v in self._evict_requested.values()]
 
+    def plan_migration(self, key: str, exclude=()) -> dict | None:
+        """Dry-run a destination for live-migrating a bound pod's proxy
+        session off its node (drain/rebalance tooling): the same
+        filter→score→normalize pipeline as a scheduling cycle, minus the
+        reserve — nothing is booked, the plan is advisory. ``exclude``
+        adds nodes the mover already knows are unusable (e.g. the one
+        being drained, when the pod is not bound there). Returns
+        ``{"pod", "from", "node", "scores"}`` or None when no other node
+        passes filtering."""
+        with self._cond:
+            pod = self.engine.pod_status.get(key)
+            if pod is None:
+                return None
+            skip = set(exclude) | ({pod.node_name} if pod.node_name
+                                   else set())
+            candidates = []
+            for node in self.engine.nodes:
+                if node in skip:
+                    continue
+                fit, why = self.engine.filter(pod, node)
+                if fit:
+                    candidates.append(node)
+                else:
+                    log.debug("plan_migration: %s rejected %s: %s",
+                              node, key, why)
+            if not candidates:
+                return None
+            raw = {n: self.engine.score(pod, n) for n in candidates}
+            norm = self.engine.normalize_scores(raw)
+            best = max(sorted(candidates), key=lambda n: norm[n])
+            return {"pod": key, "from": pod.node_name, "node": best,
+                    "scores": dict(norm)}
+
     def _requeue(self, pod: PodRequest, now: float, reason: str) -> None:
         _REQUEUES.inc()
         self._pending[pod.key] = pod

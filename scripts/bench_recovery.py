@@ -1,0 +1,225 @@
+"""Recovery micro-benchmark: what resilience actually costs.
+
+The resilience plane (doc/isolation-wire.md, resume/replay section)
+promises that a dead connection, a dead proxy, or a migration is
+invisible to callers — futures resolve, uploads land, the session
+moves. This bench puts numbers on "invisible":
+
+- ``reconnect_ms_p50/p99``: a deterministic injector severs the
+  connection under a small op; the number is kill → the same op's
+  result, i.e. detection + redial + resume + replay of one rid.
+- ``replay_put_gbps``: windowed 16 MiB upload with the connection
+  killed mid-window — effective bandwidth *including* the reconnect
+  and the restarted upload, against the clean-path ``put_gbps`` in
+  ``bench_proxy.json``.
+- ``replay_ops_per_sec``: windowed small-op dispatch with a kill in
+  the middle of the stream — pipelined throughput across a
+  resume-and-replay cycle.
+- ``migration_e2e_ms``: ``migrate_session`` end to end (freeze →
+  copy → flip) for a session holding one 4 MiB buffer and one
+  compiled program.
+
+Faults come from ``kubeshare_tpu.resilience.faults`` with fixed
+seeds, so the kill points are identical run to run. Proxies run
+in-process: recovery time is backoff + replay, not transport overlap,
+so sharing the GIL does not distort the measurement.
+
+Run: ``python scripts/bench_recovery.py`` → one JSON object
+(committed as ``bench_recovery.json``). ``--baseline FILE`` also
+prints deltas; ``--write FILE`` saves the fresh numbers
+(``make bench-recovery`` does both against ``bench_recovery.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line (the rest of the JSON is descriptive)
+_METRICS = ("reconnect_ms_p50", "reconnect_ms_p99", "replay_put_gbps",
+            "replay_ops_per_sec", "migration_e2e_ms")
+#: metrics where larger is better (the rest are latencies)
+_HIGHER_IS_BETTER = ("replay_put_gbps", "replay_ops_per_sec")
+
+WINDOW, BASE, MIN = 1000.0, 100.0, 10.0
+
+
+def _make_proxy():
+    from kubeshare_tpu.isolation.proxy import ChipProxy
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+    p = ChipProxy(scheduler=TokenScheduler(WINDOW, BASE, MIN))
+    p.serve()
+    return p
+
+
+def run_bench() -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from kubeshare_tpu.isolation.client import ProxyClient
+    from kubeshare_tpu.resilience import faults
+    from kubeshare_tpu.resilience.migrate import migrate_session
+    from kubeshare_tpu.resilience.reconnect import ReconnectPolicy
+
+    #: tight, seeded backoff — the first retry fires immediately, so the
+    #: p50 measures the recovery machinery, not a sleep schedule
+    pol = ReconnectPolicy(max_attempts=10, base_delay_s=0.01,
+                          max_delay_s=0.1, dial_timeout_s=1.0, seed=7)
+    out: dict = {"bench": "recovery: reconnect, replay, migration "
+                          "(CPU backend)"}
+
+    # --- reconnect latency: kill under a small get ----------------------
+    p = _make_proxy()
+    try:
+        c = ProxyClient("127.0.0.1", p.port, "rec", 0.5, 1.0,
+                        reconnect=pol, fault_tag="bench")
+        x = np.arange(256, dtype=np.float32)
+        bx = c.put(x)
+        for _ in range(5):                    # warm the clean path
+            c.get(bx)
+        lats = []
+        try:
+            for i in range(30):
+                faults.install(faults.Injector(faults.FaultSpec(
+                    kill_conn_after_frames=1, kill_conn_tag="bench",
+                    seed=i)))
+                t0 = time.perf_counter()
+                back = c.get(bx)              # dies, resumes, replays
+                lats.append((time.perf_counter() - t0) * 1e3)
+                faults.uninstall()
+                assert np.array_equal(back, x)
+        finally:
+            faults.uninstall()
+        out["reconnect_ms_p50"] = round(statistics.median(lats), 2)
+        out["reconnect_ms_p99"] = round(
+            sorted(lats)[int(len(lats) * 0.99) - 1], 2)
+
+        # --- replay bandwidth: windowed put killed mid-stream -----------
+        big = np.random.default_rng(0).random(
+            (4 << 20,)).astype(np.float32)    # 16 MiB
+        cb = ProxyClient("127.0.0.1", p.port, "bw", 0.5, 1.0,
+                         reconnect=pol, fault_tag="bw",
+                         chunk_bytes=256 << 10)
+        rates = []
+        try:
+            for i in range(3):
+                faults.install(faults.Injector(faults.FaultSpec(
+                    kill_conn_after_frames=16, kill_conn_tag="bw",
+                    seed=i)))
+                t0 = time.perf_counter()
+                buf = cb.put(big)             # dies mid-window, restarts
+                rates.append(big.nbytes / 1e9 * 8
+                             / (time.perf_counter() - t0))
+                faults.uninstall()
+                cb.free(buf)
+        finally:
+            faults.uninstall()
+        out["replay_put_gbps"] = round(statistics.median(rates), 2)
+
+        # --- replay op throughput: async window across a kill -----------
+        exe = cb.compile(lambda a: a + 1.0, np.float32(0))
+        sb = cb.put(np.float32(0))
+        n_ops, window = 400, 32
+        ops_rates = []
+        try:
+            for i in range(3):
+                faults.install(faults.Injector(faults.FaultSpec(
+                    kill_conn_after_frames=n_ops // 2,
+                    kill_conn_tag="bw", seed=i)))
+                pending: list = []
+                handles: list[int] = []
+                t0 = time.perf_counter()
+                for _ in range(n_ops):
+                    if len(pending) >= window:
+                        handles.extend(pending.pop(0).result())
+                    pending.append(cb.execute_async(exe._exec_id,
+                                                    [sb.handle]))
+                while pending:
+                    handles.extend(pending.pop(0).result())
+                ops_rates.append(n_ops / (time.perf_counter() - t0))
+                faults.uninstall()
+                for j in range(0, len(handles), 1000):
+                    cb._conn.call({"op": "free", "name": cb.name,
+                                   "handles": handles[j:j + 1000]})
+        finally:
+            faults.uninstall()
+        out["replay_ops_per_sec"] = round(statistics.median(ops_rates), 0)
+        cb.close()
+        c.close()
+    finally:
+        p.close()
+
+    # --- live migration end to end --------------------------------------
+    durs = []
+    mig = np.random.default_rng(1).random((1 << 20,)).astype(np.float32)
+    for _ in range(5):                        # drain kills the source:
+        p1, p2 = _make_proxy(), _make_proxy()  # fresh pair per run
+        try:
+            c = ProxyClient("127.0.0.1", p1.port, "mover", 0.5, 1.0,
+                            reconnect=pol)
+            bx = c.put(mig)                   # 4 MiB payload
+            exe = c.compile(lambda a: a * 2.0, bx)
+            t0 = time.perf_counter()
+            migrate_session(("127.0.0.1", p1.port),
+                            ("127.0.0.1", p2.port), c._conn.token,
+                            drain=True)
+            durs.append((time.perf_counter() - t0) * 1e3)
+            back = c.get(bx)                  # follows the tombstone
+            assert np.array_equal(back, mig)
+            c.close()
+        finally:
+            p1.close()
+            p2.close()
+    out["migration_e2e_ms"] = round(statistics.median(durs), 1)
+    return out
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:28s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:28s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="bench_recovery")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    main()

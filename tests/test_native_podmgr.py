@@ -170,10 +170,11 @@ def test_python_podmgr_failed_renew_disarms_crash_release():
 
 
 def test_python_podmgr_redials_after_upstream_blip():
-    """A transport error on the upstream scheduler connection must not
-    wedge the gate forever: the manager drops the dead connection and
-    re-dials on the next call (the C++ relay breaks the gate connection
-    instead; both recover)."""
+    """A transport error on the upstream scheduler connection is ridden
+    out IN PLACE: the manager re-dials with bounded backoff, re-attaches,
+    and retries the op on the fresh channel — the gate never sees the
+    blip (podmgr_relay.cpp parity, now on the resilience plane's
+    backoff machinery)."""
     from kubeshare_tpu.isolation.podmgr import PodManager
 
     sched = TokenScheduler(WINDOW, BASE, MIN)
@@ -184,17 +185,72 @@ def test_python_podmgr_redials_after_upstream_blip():
     try:
         assert mgr._handle({"op": "acquire"}, state)["quota_ms"] == BASE
         mgr._handle({"op": "release", "used_ms": 10}, state)
-        state["up"].sock.close()          # network blip
-        with pytest.raises(OSError):
-            mgr._handle({"op": "acquire"}, state)
-        assert state["up"] is None        # corpse dropped
-        assert not state.get("holding")   # not armed across the blip
-        # same gate connection recovers: re-dial + attach + acquire
+        dead = state["up"]
+        dead.sock.close()                 # network blip
+        # transparent recovery: same call succeeds on a fresh channel
         assert mgr._handle({"op": "acquire"}, state)["quota_ms"] == BASE
+        assert state["up"] is not dead    # corpse replaced, not reused
+        assert state.get("holding")       # grant armed on the fresh channel
         mgr._handle({"op": "release", "used_ms": 5}, state)
     finally:
         mgr.close()
         srv.shutdown()
+
+
+def test_python_podmgr_renew_across_blip_releases_wall_time():
+    """A blip while HOLDING: the old channel took the pod's usage report
+    down with it, so the manager must conservatively release the
+    wall-time charge before re-acquiring — a renew on the fresh channel
+    becomes a plain acquire (its release half already happened)."""
+    from kubeshare_tpu.isolation.podmgr import PodManager
+
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    mgr = PodManager("127.0.0.1", srv.server_address[1], "ns/blip-hold",
+                     request=0.5, limit=1.0)
+    state: dict = {}
+    try:
+        assert mgr._handle({"op": "acquire"}, state)["quota_ms"] == BASE
+        time.sleep(0.05)
+        state["up"].sock.close()          # blip mid-hold
+        rep = mgr._handle({"op": "renew", "used_ms": 40.0}, state)
+        assert rep["quota_ms"] == BASE    # re-granted on the fresh channel
+        assert state.get("holding")
+        # the conservative release charged ~wall time (capped at quota),
+        # NOT the 40 ms the gate reported (that report never arrived)
+        used = sched.window_usage("ns/blip-hold")
+        assert 0.0 < used <= BASE
+        mgr._handle({"op": "release", "used_ms": 5}, state)
+    finally:
+        mgr.close()
+        srv.shutdown()
+
+
+def test_python_podmgr_scheduler_stays_down_surfaces():
+    """An exhausted reconnect budget surfaces to the gate (SessionLost is
+    an OSError subtype) instead of hanging the relay forever."""
+    from kubeshare_tpu.isolation.podmgr import PodManager
+    from kubeshare_tpu.resilience.reconnect import (ReconnectPolicy,
+                                                    SessionLost)
+
+    sched = TokenScheduler(WINDOW, BASE, MIN)
+    srv = serve(sched)
+    mgr = PodManager("127.0.0.1", srv.server_address[1], "ns/down",
+                     request=0.5, limit=1.0)
+    mgr.RECONNECT = ReconnectPolicy(max_attempts=2, base_delay_s=0.01,
+                                    max_delay_s=0.02, dial_timeout_s=0.2)
+    state: dict = {}
+    try:
+        assert mgr._handle({"op": "acquire"}, state)["quota_ms"] == BASE
+        mgr._handle({"op": "release", "used_ms": 10}, state)
+        srv.shutdown()                    # scheduler gone for good
+        srv.server_close()                # (listening socket too)
+        state["up"].sock.close()
+        with pytest.raises(SessionLost):
+            mgr._handle({"op": "acquire"}, state)
+        assert not state.get("holding")
+    finally:
+        mgr._up.close()
 
 
 def test_native_relay_retries_duplicate_until_old_owner_reaped(relay_bin):

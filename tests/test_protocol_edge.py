@@ -210,3 +210,66 @@ def test_negotiate_features_intersects():
     assert protocol.negotiate_features(["seq", "frobnicate"]) == ["seq"]
     assert protocol.negotiate_features([]) == []
     assert protocol.negotiate_features(("seq",)) == ["seq"]
+
+
+# -- resume/replay wire semantics ---------------------------------------------
+
+
+def test_put_abort_races_connection_drop():
+    """A client that drops mid-upload and aborts the staged put after
+    resuming must find the abort idempotent: the disconnect already
+    invalidated the staging (releasing its HBM reservation), so neither
+    the raced abort, nor its replay, may double-release or error — while
+    a replayed CHUNK of the invalidated upload is refused with the
+    restart-upload error."""
+    from kubeshare_tpu.isolation.proxy import ChipProxy
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    p = ChipProxy(scheduler=TokenScheduler(1000.0, 100.0, 10.0))
+    p.serve()
+    try:
+        conn = protocol.Connection("127.0.0.1", p.port)
+        rep, _ = conn.call({"op": "register", "name": "abrt",
+                            "request": 0.5, "limit": 1.0, "memory": 0,
+                            "features": ["resume"]})
+        token = rep["resume"]
+        rep, _ = conn.call({"op": "put_begin", "nbytes": 1 << 16,
+                            protocol.RID_KEY: 1})
+        sid = rep["staging"]
+        conn.call({"op": "put_chunk", "staging": sid, "offset": 0,
+                   protocol.RID_KEY: 2}, blob=b"z" * 1024)
+        conn.sock.close()     # hard drop, racing the abort below
+
+        c2 = protocol.Connection("127.0.0.1", p.port)
+        try:
+            rep, _ = c2.call({"op": "register", "resume": token})
+            assert rep.get("resumed") and rep["last_rid"] == 2
+            # a chunk the server never saw (rid 3) replays against the
+            # invalidated staging: refused with the restart-upload error
+            with pytest.raises(RuntimeError,
+                               match="invalidated by disconnect"):
+                c2.call({"op": "put_chunk", "staging": sid, "offset": 1024,
+                         protocol.RID_KEY: 3}, blob=b"z" * 16)
+            # the raced abort lands as a fresh request after resume:
+            # idempotent ok, reservation not released a second time
+            rep, _ = c2.call({"op": "put_abort", "staging": sid,
+                              protocol.RID_KEY: 4})
+            assert rep["ok"]
+            rep, _ = c2.call({"op": "usage", protocol.RID_KEY: 5})
+            assert rep["hbm_used"] == 0
+            # ack the abort's cached reply away, then replay it: the op
+            # RE-EXECUTES (put_abort is idempotent) — no double-release,
+            # no KeyError on the long-gone staging entry
+            rep, _ = c2.call({"op": "usage", protocol.RID_KEY: 6,
+                              protocol.ACK_KEY: 5})
+            assert rep["hbm_used"] == 0
+            rep, _ = c2.call({"op": "put_abort", "staging": sid,
+                              protocol.RID_KEY: 4})
+            assert rep["ok"]
+            rep, _ = c2.call({"op": "usage", protocol.RID_KEY: 7})
+            assert rep["hbm_used"] == 0
+            c2.call({"op": "unregister", protocol.RID_KEY: 8})
+        finally:
+            c2.close()
+    finally:
+        p.close()

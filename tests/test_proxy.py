@@ -193,6 +193,9 @@ def test_host_uploads_freed_per_call(proxy):
 
 
 def test_disconnect_frees_session(proxy):
+    # resumable sessions park for detach_grace_ms before the watchdog
+    # reclaims them; shrink the grace so the drop lands within the poll
+    proxy.detach_grace_ms = 100.0
     c = connect(proxy, "gone")
     c.put(np.zeros(10, np.float32))
     c._conn.close()  # hard drop, no unregister
@@ -203,6 +206,20 @@ def test_disconnect_frees_session(proxy):
     # name is reusable after cleanup
     with connect(proxy, "gone") as c2:
         assert c2.usage()["hbm_used"] == 0
+
+
+def test_legacy_disconnect_frees_immediately(proxy):
+    """A ``reconnect=None`` client requests no resume token, so its hard
+    drop frees the session without waiting out the detach grace."""
+    c = ProxyClient("127.0.0.1", proxy.port, "legacy", request=0.5,
+                    limit=1.0, reconnect=None)
+    assert "resume" not in c.features
+    c.put(np.zeros(10, np.float32))
+    c._conn.close()
+    deadline = time.monotonic() + 2.0
+    while proxy.scheduler.core.client_count() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert proxy.scheduler.core.client_count() == 0
 
 
 def _greedy_client(proxy, name, request, stop, used_out, nloops=20):
