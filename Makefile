@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health image clean obs-check
 
 all: native
 
@@ -52,6 +52,13 @@ bench-proxy:
 bench-recovery:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_recovery.py \
 		--baseline bench_recovery.json --write bench_recovery.json
+
+# Health-plane micro-bench (doc/health.md): detection latency p50/p99,
+# evict->rebound end to end, poll + admission cost; refreshes
+# bench_health.json.
+bench-health:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_health.py \
+		--baseline bench_health.json --write bench_health.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -29,6 +29,11 @@ POD_TPU_MEMORY = DOMAIN + "tpu_mem"
 # Chip model constraint, e.g. "tpu-v4" / "tpu-v5e" (constants.go:22-23).
 POD_TPU_MODEL = DOMAIN + "tpu_model"
 
+# Scheduling deadline in seconds (≙ a sharedgpu/deadline-style label):
+# a pod still unbound this long after submit resolves "timed-out"
+# instead of retrying forever. 0/absent = no deadline.
+POD_DEADLINE = DOMAIN + "deadline"
+
 # --- scheduler-written annotations (constants.go:25-27) ---------------------
 POD_TPU_CHIP_ID = DOMAIN + "tpu_chip_id"     # ≙ sharedgpu/gpu_uuid
 POD_CELL_ID = DOMAIN + "cell_id"
@@ -101,3 +106,11 @@ SCHEDULER_NAME = "kubeshare-tpu-scheduler"
 # 9005 ports, cmd/kubeshare-collector/main.go + cmd/kubeshare-aggregator).
 REGISTRY_PORT = 9006
 SCHEDULER_PORT = 9007
+
+# Health plane defaults (doc/health.md). The reference implicitly ages
+# out dead nodes via Prometheus scrape staleness (~5 s scrape + 5-10 s
+# query window); the lease TTL plays that role explicitly here.
+LEASE_TTL_S = 5.0            # heartbeat lease lifetime
+HEALTH_MISS_THRESHOLD = 3    # missed TTLs before a suspect node is dead
+HEALTH_RECOVER_K = 3         # consecutive fresh beats to leave quarantine
+HEALTH_QUARANTINE_S = 30.0   # minimum hold-down after a death

@@ -12,6 +12,15 @@ installing the next (Prometheus endpoints, the ``gpu_capacity`` metric —
    render; how many capacity/requirement records live there?
 4. **scheduler** — is the service reachable; does ``/state`` show nodes?
 5. **node files** — does the per-chip client-list directory exist?
+6. **leases** — does the registry's ``/leases`` endpoint answer (the
+   health plane's wire, ``doc/health.md``)?
+7. **heartbeat** — is THIS node's lease fresh (age < its TTL)? A deployed
+   agent whose beats aren't landing is exactly a silent future eviction.
+8. **clockskew** — |local clock − registry clock| < TTL/4. Lease ages are
+   computed on the registry's clock, so the health plane itself tolerates
+   any skew — but a drifting node corrupts every *other* cross-host
+   timestamp (capacity ages, trace spans), and TTL/4 is where an operator
+   eyeballing ages starts drawing wrong conclusions.
 
 Each check prints ``ok`` / ``fail`` / ``skip`` with one diagnostic line;
 exit code is non-zero when any check fails. Network checks default to the
@@ -166,6 +175,75 @@ def check_scheduler(addr: str, timeout_s: float,
     return _result("scheduler", "ok", f"{addr}: {n} node(s) in the engine")
 
 
+def check_leases(addr: str, timeout_s: float, node: str,
+                 defaulted: bool = False) -> bool:
+    """Three health-plane probes against one ``/leases`` read: endpoint
+    reachable, this node's lease fresh, clock skew < TTL/4."""
+    import time
+
+    if not addr or addr == "none":
+        _result("leases", "skip", "--registry none")
+        _result("heartbeat", "skip", "--registry none")
+        _result("clockskew", "skip", "--registry none")
+        return True
+    from .telemetry.registry import RegistryClient
+    host, _, port = addr.partition(":")
+    local_now = time.time()
+    try:
+        body = RegistryClient(host, int(port), timeout=timeout_s).leases()
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            _result("leases", "skip",
+                    f"{addr} refused (no cluster on this host)")
+            _result("heartbeat", "skip", "no registry")
+            _result("clockskew", "skip", "no registry")
+            return True
+        _result("heartbeat", "skip", "lease endpoint unreachable")
+        _result("clockskew", "skip", "lease endpoint unreachable")
+        return _result("leases", "fail", f"{addr}: {exc}")
+    leases = body.get("leases", {}) if isinstance(body, dict) else {}
+    server_now = body.get("now") if isinstance(body, dict) else None
+    ok = _result("leases", "ok",
+                 f"{addr}: {len(leases)} lease(s) published")
+
+    lease = leases.get(node)
+    if lease is None:
+        _result("heartbeat", "skip",
+                f"no lease for this node ({node}) — heartbeater not "
+                "running here")
+    else:
+        age, ttl = float(lease.get("age_s", 0.0)), \
+            float(lease.get("ttl_s", C.LEASE_TTL_S))
+        if age < ttl:
+            ok &= _result("heartbeat", "ok",
+                          f"{node}: lease age {age:.1f}s < ttl {ttl:.0f}s "
+                          f"(epoch {lease.get('epoch')})")
+        else:
+            ok &= _result("heartbeat", "fail",
+                          f"{node}: lease STALE ({age:.1f}s >= ttl "
+                          f"{ttl:.0f}s) — the healthwatch will evict "
+                          "this node")
+
+    if server_now is None:
+        _result("clockskew", "skip", "registry predates /leases 'now'")
+    else:
+        ttl = (float(leases[node]["ttl_s"]) if node in leases
+               else C.LEASE_TTL_S)
+        skew = abs(local_now - float(server_now))
+        limit = ttl / 4.0
+        if skew < limit:
+            ok &= _result("clockskew", "ok",
+                          f"|local - registry| = {skew:.2f}s < ttl/4 "
+                          f"({limit:.2f}s)")
+        else:
+            ok &= _result("clockskew", "fail",
+                          f"|local - registry| = {skew:.2f}s >= ttl/4 "
+                          f"({limit:.2f}s) — fix NTP before trusting "
+                          "cross-host timestamps")
+    return ok
+
+
 def check_node_files(base_dir: str) -> bool:
     cfg = os.path.join(base_dir, "config")
     if not os.path.isdir(base_dir):
@@ -223,6 +301,9 @@ def main(argv=None) -> int:
     ok &= check_registry(registry, 5.0, defaulted=reg_defaulted)
     ok &= check_scheduler(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
+    from .utils import default_node_name
+    ok &= check_leases(registry, 5.0, default_node_name(),
+                       defaulted=reg_defaulted)
     return 0 if ok else 1
 
 

@@ -223,17 +223,35 @@ def main(argv=None) -> None:
     parser.add_argument("--base-dir", default=C.SCHEDULER_DIR)
     parser.add_argument("--backend", default="auto")
     parser.add_argument("--poll", type=float, default=DEFAULT_POLL_S)
+    parser.add_argument("--registry-host", default="",
+                        help="publish heartbeat leases to this telemetry "
+                             "registry (doc/health.md); empty = no "
+                             "heartbeating (standalone launcher)")
+    parser.add_argument("--registry-port", type=int,
+                        default=C.REGISTRY_PORT)
+    parser.add_argument("--lease-ttl", type=float, default=C.LEASE_TTL_S)
     args = parser.parse_args(argv)
 
     chips = discover_chips(args.backend, host=args.node)
     daemon = LauncherDaemon([c.chip_id for c in chips],
                             base_dir=args.base_dir, poll_s=args.poll)
     daemon.start()
+    heartbeat = None
+    if args.registry_host:
+        # the launcher IS the node's liveness: if this process dies, the
+        # lease stops renewing and the healthwatch evicts the node
+        from ..telemetry.heartbeat import Heartbeater
+        from ..telemetry.registry import RegistryClient
+        registry = RegistryClient(args.registry_host, args.registry_port)
+        heartbeat = Heartbeater(registry, args.node,
+                                ttl_s=args.lease_ttl).start()
     print("READY", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if heartbeat is not None:
+        heartbeat.stop()
     daemon.stop()
 
 

@@ -20,6 +20,20 @@ transport/proxy hooks consult it at well-defined points:
 - ``delay_writer_ms=D`` — every server write batch sleeps first, for
   shaking out timing-dependent window/credit bugs.
 
+Control-plane injectors (the health plane's drill switchboard,
+``doc/health.md``):
+
+- ``suppress_heartbeats_node=N`` — heartbeats from node ``N`` (``*`` =
+  every node) are silently dropped before they reach the registry,
+  after ``suppress_heartbeats_after`` beats were let through — a
+  killed node agent, as seen by the lease plane;
+- ``flap_node=N`` + ``flap_beats=K`` — node ``N``'s beats alternate:
+  ``K`` delivered, ``K`` suppressed, repeating — the flapping node the
+  healthwatch's quarantine exists for;
+- ``partition_registry_ops=N`` — the next ``N`` RegistryClient HTTP
+  attempts fail with a transport error (a network partition between
+  this process and the registry; retries burn through the budget).
+
 Injectors hold no references into the transport (this module imports
 nothing from ``isolation`` — the dependency points the other way), and
 every decision is made under a lock from seeded state, so a fault matrix
@@ -55,6 +69,19 @@ class FaultSpec:
     crash_proxy_after_chunks: int = 0
     #: every server write batch sleeps this long first (0 disables).
     delay_writer_ms: float = 0.0
+    #: suppress heartbeats from this node ("*" matches every node;
+    #: empty disables).
+    suppress_heartbeats_node: str = ""
+    #: let this many beats through before suppression starts (0 =
+    #: suppress from the first beat).
+    suppress_heartbeats_after: int = 0
+    #: flapping node: alternate flap_beats delivered / flap_beats
+    #: suppressed for this node (empty disables).
+    flap_node: str = ""
+    flap_beats: int = 0
+    #: fail the next N RegistryClient HTTP attempts with a transport
+    #: error (0 disables).
+    partition_registry_ops: int = 0
     #: seed for any randomized decision; fixed default keeps unseeded
     #: runs reproducible too.
     seed: int = 0
@@ -77,6 +104,8 @@ class Injector:
         self._kills = 0
         self._chunks = 0
         self._dropped = False
+        self._beats: dict[str, int] = {}     # per-node heartbeat count
+        self._partitioned = 0                # registry ops failed so far
 
     # -- client connection: frames sent ---------------------------------
 
@@ -118,6 +147,38 @@ class Injector:
 
     def writer_delay_s(self) -> float:
         return max(self.spec.delay_writer_ms, 0.0) / 1000.0
+
+    # -- control plane ---------------------------------------------------
+
+    def should_suppress_heartbeat(self, node: str) -> bool:
+        """Called per heartbeat a publisher is about to send; True → the
+        beat must be silently dropped. Counts are per node, so one
+        injector can drill one node while the rest of the fleet beats."""
+        spec = self.spec
+        suppress = spec.suppress_heartbeats_node and \
+            spec.suppress_heartbeats_node in ("*", node)
+        flap = spec.flap_node == node and spec.flap_beats > 0
+        if not suppress and not flap:
+            return False
+        with self._mu:
+            beat = self._beats.get(node, 0)
+            self._beats[node] = beat + 1
+        if suppress and beat >= spec.suppress_heartbeats_after:
+            return True
+        # flapping: K beats delivered, K suppressed, repeating
+        return flap and (beat // spec.flap_beats) % 2 == 1
+
+    def should_partition_registry(self) -> bool:
+        """Called per RegistryClient HTTP attempt; True → the attempt
+        must fail as if the network dropped it."""
+        spec = self.spec
+        if not spec.partition_registry_ops:
+            return False
+        with self._mu:
+            if self._partitioned >= spec.partition_registry_ops:
+                return False
+            self._partitioned += 1
+            return True
 
     # -- proxy worker ----------------------------------------------------
 
@@ -169,12 +230,15 @@ def from_env(environ=None) -> Injector | None:
             continue
         key, _, value = item.partition("=")
         key = key.strip()
-        if key == "kill_conn_tag":
+        if key in ("kill_conn_tag", "suppress_heartbeats_node",
+                   "flap_node"):
             kwargs[key] = value.strip()
         elif key == "delay_writer_ms":
             kwargs[key] = float(value)
         elif key in ("kill_conn_after_frames", "kill_conn_repeat",
-                     "drop_reply_seq", "crash_proxy_after_chunks", "seed"):
+                     "drop_reply_seq", "crash_proxy_after_chunks", "seed",
+                     "suppress_heartbeats_after", "flap_beats",
+                     "partition_registry_ops"):
             kwargs[key] = int(value)
         else:
             raise ValueError(f"unknown fault field {key!r}")

@@ -205,6 +205,13 @@ class ServiceClient:
             raise RuntimeError(f"/evictions returned {code}")
         return body.get("evictions", [])
 
+    def health(self) -> dict:
+        """Liveness snapshot (``GET /health``, doc/health.md)."""
+        code, body = self._call("GET", "/health")
+        if code != 200:
+            raise RuntimeError(f"/health returned {code}")
+        return body
+
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
 

@@ -56,11 +56,32 @@ _BIND_LAT = _OBS.histogram(
 _REQUEUES = _OBS.counter(
     "kubeshare_sched_requeues_total",
     "Pods requeued with backoff after an unschedulable cycle.")
+_SHEDS = _OBS.counter(
+    "kubeshare_sched_sheds_total",
+    "Submissions rejected by the bounded admission queue.",
+    labels=("reason",))
+_TIMEOUTS = _OBS.counter(
+    "kubeshare_sched_deadline_timeouts_total",
+    "Pending pods resolved timed-out past their sharedtpu/deadline.")
+_HEALTH_EVICTIONS = _OBS.counter(
+    "kubeshare_health_evictions_total",
+    "Pods evicted off dead nodes, by what happened to their session.",
+    labels=("outcome",))
+
+
+class Overloaded(RuntimeError):
+    """Typed admission rejection: the bounded queue (``max_pending``,
+    per-namespace fair share) refused the submit (doc/health.md)."""
+
+    def __init__(self, msg: str, reason: str = "max-pending"):
+        super().__init__(msg)
+        self.reason = reason
 
 
 @dataclass
 class Outcome:
-    status: str                    # "bound" | "rejected" | "deleted"
+    #: "bound" | "rejected" | "deleted" | "overloaded" | "timed-out"
+    status: str
     reason: str = ""
     binding: Binding | None = None
 
@@ -98,11 +119,17 @@ class Dispatcher:
     def __init__(self, engine: SchedulerEngine, registry=None,
                  gc_period_s: float = GC_PERIOD_S,
                  retry_backoff_s: float = RETRY_BACKOFF_S,
-                 clock=time.monotonic, sync=None):
+                 clock=time.monotonic, sync=None,
+                 max_pending: int | None = None):
         self.engine = engine
         self.registry = registry
         self.gc_period_s = gc_period_s
         self.retry_backoff_s = retry_backoff_s
+        #: bounded admission: submits beyond this many pending pods are
+        #: refused with :class:`Overloaded` (None = unbounded, the
+        #: pre-health-plane behavior); under multi-namespace contention
+        #: each namespace is capped at its fair share of the bound
+        self.max_pending = max_pending
         self._clock = clock
         self._sync = sync               # callable(): refresh capacity
         self._cond = threading.Condition()
@@ -115,9 +142,24 @@ class Dispatcher:
         #: served via /evictions, executed by the bridge (API delete),
         #: completed by the victim's normal DELETED event
         self._evict_requested: dict[str, dict] = {}
+        #: pods thrown off a dead node and not yet rebound: key →
+        #: {"node", "since", "outcome"} — status() reports "node lost"
+        #: instead of whatever generic reason later retries produce
+        self._health_evicted: dict[str, dict] = {}
+        #: lease-driven failure detector (attach_healthwatch); polled
+        #: from the step loop under the lock
+        self.healthwatch = None
+        self.shed_total = 0
         self._next_gc = 0.0
         self._stop = False
         self._thread: threading.Thread | None = None
+
+    def attach_healthwatch(self, hw) -> "Dispatcher":
+        """Wire a :class:`~.healthwatch.HealthWatch`: every step polls
+        it under the dispatcher lock, so detection → veto → eviction is
+        serialized with scheduling decisions."""
+        self.healthwatch = hw
+        return self
 
     @property
     def lock(self) -> threading.Condition:
@@ -127,11 +169,50 @@ class Dispatcher:
 
     # -- intake ------------------------------------------------------------
 
+    def _check_admission(self, namespace: str, name: str) -> None:
+        """Bounded admission (caller holds the lock): refuse NEW load
+        past ``max_pending``; resubmits of known pods always pass — a
+        poll/retry of queued work is not new load. Under multi-namespace
+        contention one namespace cannot take the whole queue: each is
+        capped at ``max_pending // active_namespaces`` (doc/health.md)."""
+        if self.max_pending is None:
+            return
+        key = f"{namespace}/{name}"
+        if (key in self._pending or key in self._parked
+                or key in self.engine.pod_status):
+            return
+        total = len(self._pending)
+        if total >= self.max_pending:
+            reason = "max-pending"
+        else:
+            active = {k.partition("/")[0] for k in self._pending}
+            active.add(namespace)
+            if len(active) < 2:
+                return
+            share = max(1, self.max_pending // len(active))
+            mine = sum(1 for k in self._pending
+                       if k.partition("/")[0] == namespace)
+            if mine < share:
+                return
+            reason = "fair-share"
+        self.shed_total += 1
+        _SHEDS.inc(reason)
+        msg = (f"admission queue full ({total}/{self.max_pending} "
+               f"pending)" if reason == "max-pending" else
+               f"namespace {namespace} over its fair share of the "
+               f"admission queue ({self.max_pending} pending cap)")
+        self._resolve(key, Outcome("overloaded", msg))
+        log.warning("shed %s: %s", key, msg)
+        raise Overloaded(msg, reason)
+
     def submit(self, namespace: str, name: str, labels: dict,
                uid: str = "") -> str:
-        """Parse + enqueue; raises LabelError on bad labels. Returns the
-        pod key (poll with :meth:`status` / :meth:`outcome`)."""
+        """Parse + enqueue; raises LabelError on bad labels and
+        :class:`Overloaded` when the bounded admission queue refuses new
+        load. Returns the pod key (poll with :meth:`status` /
+        :meth:`outcome`)."""
         with self._cond:
+            self._check_admission(namespace, name)
             pod = self.engine.submit(namespace, name, labels, uid=uid)
             parked = self._parked.get(pod.key)
             if parked is not None:
@@ -177,6 +258,14 @@ class Dispatcher:
                         "deadline_s": max(0.0,
                                           parked.deadline - self._clock())}
             if key in self._pending:
+                ev = self._health_evicted.get(key)
+                if ev is not None:
+                    # the load-bearing reason: later unschedulable
+                    # retries must not bury WHY the pod is back in the
+                    # queue (its node died under it)
+                    return {"status": "pending",
+                            "reason": f"node lost ({ev['node']})",
+                            "evicted_from": ev["node"]}
                 return {"status": "pending",
                         "reason": self._last_reason.get(key, "")}
             return {"status": "unknown"}
@@ -212,11 +301,35 @@ class Dispatcher:
             self.engine.groups.gc()
             self._next_gc = now + self.gc_period_s
 
+        if self.healthwatch is not None:
+            try:
+                self.healthwatch.poll(now, self)
+            except Exception:
+                # detection must never take the scheduling loop with it
+                log.exception("healthwatch poll failed")
+
         for key in [k for k, p in self._parked.items() if p.deadline <= now]:
             if key in self._parked:     # may be gone via gang rejection
                 log.info("gang permit timeout for %s", key)
                 self._reject_gang(self._parked[key].pod,
                                   "gang permit timeout")
+
+        # per-pod deadlines: a pod still unbound past sharedtpu/deadline
+        # resolves "timed-out" instead of retrying forever
+        for key in [k for k, p in self._pending.items()
+                    if p.deadline_s > 0
+                    and now - p.timestamp >= p.deadline_s]:
+            pod = self._pending.pop(key)
+            self._retry_at.pop(key, None)
+            self.engine.delete_pod(key)
+            self._withdraw(key)
+            _TIMEOUTS.inc()
+            log.info("%s timed out after %.1fs unscheduled", key,
+                     now - pod.timestamp)
+            self._resolve(key, Outcome(
+                "timed-out",
+                f"unscheduled for {now - pod.timestamp:.1f}s "
+                f"(deadline {pod.deadline_s:.1f}s)"))
 
         synced = False
         progressed = True
@@ -271,6 +384,11 @@ class Dispatcher:
             nxt = min(nxt, parked.deadline)
         for t in self._retry_at.values():
             nxt = min(nxt, t)
+        for pod in self._pending.values():
+            if pod.deadline_s > 0:
+                nxt = min(nxt, pod.timestamp + pod.deadline_s)
+        if self.healthwatch is not None:
+            nxt = min(nxt, self.healthwatch._next_poll)
         return max(0.0, nxt - now)
 
     def _pick(self, now: float) -> str | None:
@@ -435,6 +553,90 @@ class Dispatcher:
             return {"pod": key, "from": pod.node_name, "node": best,
                     "scores": dict(norm)}
 
+    def evict_node(self, node: str, now: float | None = None, *,
+                   reason: str = "node lost",
+                   migrate_fn=None) -> list[str]:
+        """Throw every pod off a dead node and requeue it (the
+        healthwatch's dead transition, doc/health.md). Gang semantics
+        stay intact: ONE dead member evicts the WHOLE group and resets
+        its placement plan — a half-reserved gang slot must never leak.
+        ``migrate_fn(pod, plan)`` (when given) is tried first for
+        groupless bound pods: True means the pod's proxy session was
+        live-migrated to ``plan["node"]`` (resilience/migrate.py) and
+        the requeue is a formality; False/raise falls back to the cold
+        requeue. Returns the evicted keys."""
+        with self._cond:   # re-entrant: the healthwatch calls this
+            return self._evict_node_locked(
+                node, self._clock() if now is None else now, reason,
+                migrate_fn)
+
+    def _evict_node_locked(self, node: str, now: float, reason: str,
+                           migrate_fn) -> list[str]:
+        eng = self.engine
+        keys: list[str] = []
+        seen_groups: set[str] = set()
+        for pod in list(eng.pod_status.values()):
+            if pod.node_name != node:
+                continue
+            if pod.group_name:
+                if pod.group_key in seen_groups:
+                    continue
+                seen_groups.add(pod.group_key)
+                # one dead member re-plans the whole gang
+                for member in eng._group_members(pod):
+                    if member.key not in keys:
+                        keys.append(member.key)
+            elif pod.key not in keys:
+                keys.append(pod.key)
+        if not keys:
+            return []
+        tracer = get_tracer()
+        evicted: list[str] = []
+        for key in keys:
+            pod = eng.pod_status.get(key)
+            if pod is None:
+                continue
+            if pod.group_name:
+                group = eng.group_of(pod)
+                group.plan = None
+                group.plan_taken = {}
+                group.plan_stale_gen = -1
+                group.plan_checked_gen = -1
+            outcome = "requeued"
+            if (migrate_fn is not None and pod.node_name == node
+                    and not pod.group_name):
+                plan = self.plan_migration(key, exclude=(node,))
+                if plan is not None:
+                    try:
+                        if migrate_fn(pod, plan):
+                            outcome = "migrated"
+                    except Exception as e:
+                        log.warning("migration of %s off %s failed, "
+                                    "cold requeue: %s", key, node, e)
+            eng.unreserve(pod)        # bookings, rank, port, plan slot
+            self._parked.pop(key, None)
+            self._retry_at.pop(key, None)
+            self._withdraw(key)
+            self._results.pop(key, None)   # the stale bound outcome
+            pod.timestamp = now            # queue-wait restarts here
+            self._pending[key] = pod
+            self._retry_at[key] = now      # no backoff: reschedule NOW
+            self._last_reason[key] = f"{reason} ({node})"
+            self._health_evicted[key] = {"node": node, "since": now,
+                                         "outcome": outcome}
+            _HEALTH_EVICTIONS.inc(outcome)
+            _REQUEUES.inc()
+            ts = tracer.now_ms()
+            tracer.record("node-lost-evict", pod.trace_id, ts, ts,
+                          parent_id=(pod.trace_span.span_id
+                                     if pod.trace_span else ""),
+                          pod=key, node=node, outcome=outcome)
+            evicted.append(key)
+        log.warning("node %s lost: evicted %d pod(s): %s", node,
+                    len(evicted), ", ".join(evicted))
+        self._cond.notify_all()
+        return evicted
+
     def _requeue(self, pod: PodRequest, now: float, reason: str) -> None:
         _REQUEUES.inc()
         self._pending[pod.key] = pod
@@ -471,6 +673,8 @@ class Dispatcher:
         self._results.pop(key, None)   # re-insert at the back (LRU order)
         self._results[key] = outcome
         self._last_reason.pop(key, None)
+        self._health_evicted.pop(key, None)  # rebound (or gone): the
+        # "node lost" story ends with a terminal disposition
         # bound retention: without eviction a long-running scheduler keeps
         # an Outcome (with its Binding) for every pod EVER seen
         scan = len(self._results) - MAX_RESULTS

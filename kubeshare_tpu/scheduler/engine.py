@@ -160,6 +160,14 @@ class SchedulerEngine:
         self.leaf_cells: dict = {}
         self.chips_by_node: dict[str, dict[str, list[ChipInfo]]] = {}
         self.node_health: dict[str, bool] = {}
+        #: health the capacity feed *reported*, before the veto below —
+        #: needed to restore a node when its veto lifts
+        self._reported_health: dict[str, bool] = {}
+        #: nodes the healthwatch holds out of scoring (dead/quarantined).
+        #: Capacity and health are independent axes: a capacity re-put
+        #: with healthy=True must NOT resurrect a vetoed node — only
+        #: :meth:`veto_health` lifts the veto (doc/health.md).
+        self.health_veto: set[str] = set()
         self.ports: dict[str, RRBitmap] = {}
         self.pod_status: dict[str, PodRequest] = {}
         self.groups = PodGroupRegistry(clock=clock)
@@ -199,7 +207,9 @@ class SchedulerEngine:
             by_model.setdefault(chip.model, []).append(chip)
         changed = not known or self.chips_by_node[node_name] != by_model
         self.chips_by_node[node_name] = by_model
-        self.node_health[node_name] = healthy
+        self._reported_health[node_name] = healthy
+        self.node_health[node_name] = (healthy
+                                       and node_name not in self.health_veto)
         if node_name not in self.ports:
             bitmap = RRBitmap(C.POD_MANAGER_PORT_RANGE)
             bitmap.mask(0)  # parity: port base is never handed out
@@ -212,7 +222,8 @@ class SchedulerEngine:
                             "topology config; cells keep the configured "
                             "shape", node_name)
             set_node_status(self.free_list, self.chips_by_node,
-                            self.leaf_cells, node_name, healthy)
+                            self.leaf_cells, node_name,
+                            self.node_health[node_name])
 
     def set_fleet(self, fleet: dict[str, tuple[list[ChipInfo], bool]]) -> None:
         """Batch inventory update: one rebuild for the whole fleet instead
@@ -233,13 +244,18 @@ class SchedulerEngine:
         for gone in set(self.chips_by_node) - set(fleet):
             del self.chips_by_node[gone]
             self.node_health.pop(gone, None)
+            self._reported_health.pop(gone, None)
+            # the veto is NOT cleared: a dead node flapping out of and
+            # back into the fleet stays quarantined until recovery
             log.info("node %s left the fleet", gone)
         for node_name, (chips, healthy) in fleet.items():
             by_model: dict[str, list[ChipInfo]] = {}
             for chip in chips:
                 by_model.setdefault(chip.model, []).append(chip)
             self.chips_by_node[node_name] = by_model
-            self.node_health[node_name] = healthy
+            self._reported_health[node_name] = healthy
+            self.node_health[node_name] = (
+                healthy and node_name not in self.health_veto)
             if node_name not in self.ports:
                 bitmap = RRBitmap(C.POD_MANAGER_PORT_RANGE)
                 bitmap.mask(0)
@@ -277,9 +293,31 @@ class SchedulerEngine:
     def set_node_health(self, node_name: str, healthy: bool) -> None:
         self._fleet_snapshot = None
         self.alloc_gen += 1
-        self.node_health[node_name] = healthy
+        self._reported_health[node_name] = healthy
+        effective = healthy and node_name not in self.health_veto
+        self.node_health[node_name] = effective
         set_node_status(self.free_list, self.chips_by_node, self.leaf_cells,
-                        node_name, healthy)
+                        node_name, effective)
+
+    def veto_health(self, node_name: str, vetoed: bool) -> None:
+        """Hold a node out of scoring regardless of its reported health
+        (the healthwatch's dead/quarantined hold, doc/health.md). The
+        veto survives capacity re-puts — ``put_capacity`` for a
+        quarantined node must not resurrect it; lifting the veto
+        restores whatever health the capacity feed last reported."""
+        if vetoed == (node_name in self.health_veto):
+            return
+        if vetoed:
+            self.health_veto.add(node_name)
+        else:
+            self.health_veto.discard(node_name)
+        if node_name in self.chips_by_node:
+            self.set_node_health(
+                node_name, self._reported_health.get(node_name, True))
+        else:
+            # not (currently) in the fleet: nothing to re-status, but the
+            # next identical-capacity sync must still re-apply the veto
+            self._fleet_snapshot = None
 
     @property
     def nodes(self) -> list[str]:

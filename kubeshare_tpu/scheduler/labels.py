@@ -58,6 +58,10 @@ class PodRequest:
     limit: float = 0.0
     memory: int = 0
     model: str = ""
+    #: scheduling deadline (seconds after submit/requeue); 0 = none —
+    #: past it the dispatcher resolves the pod "timed-out" instead of
+    #: retrying forever (sharedtpu/deadline, doc/health.md)
+    deadline_s: float = 0.0
 
     group_name: str = ""
     headcount: int = 0
@@ -178,6 +182,9 @@ def parse_pod_labels(namespace: str, name: str, labels: dict,
     (pr.group_name, pr.headcount, pr.threshold,
      pr.min_available) = parse_group_labels(labels)
     pr.priority = _parse_priority(labels)
+    # deadline is orthogonal to the TPU labels: a regular workload can
+    # carry one too (the dispatcher is its queue either way)
+    pr.deadline_s = _parse_number(labels, C.POD_DEADLINE) or 0.0
 
     has_any = any(k in labels for k in
                   (C.POD_TPU_LIMIT, C.POD_TPU_REQUEST, C.POD_TPU_MEMORY))

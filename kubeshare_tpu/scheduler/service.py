@@ -19,7 +19,13 @@ API (JSON):
 - ``POST /resync``    {"namespace","name","labels","annotations","node"}
 - ``DELETE /pods/<ns>/<name>``
 - ``GET  /state``     engine snapshot (nodes, leaves, pods)
+- ``GET  /health``    per-node liveness states + shed/evicted totals
+  (doc/health.md; empty when the health plane is off)
 - ``GET  /healthz``
+
+Overload shedding: with ``max_pending`` set, ``POST /schedule`` answers
+**429** with the typed reason ("max-pending" hard cap or "fair-share"
+per-namespace) when the bounded admission queue refuses the pod.
 
 The creator of a gang member is NOT blocked while the gang forms (the
 reference's Permit blocks a scheduler goroutine, never the pod's
@@ -36,8 +42,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..telemetry.aggregator import sync_engine_from_registry
 from ..telemetry.registry import RegistryClient, TelemetryRegistry
 from ..utils.logger import get_logger
-from .dispatcher import Dispatcher
+from .dispatcher import Dispatcher, Overloaded
 from .engine import SchedulerEngine, Unschedulable
+from .healthwatch import HealthWatch
 from .labels import LabelError
 
 log = get_logger("schedsvc")
@@ -46,13 +53,21 @@ log = get_logger("schedsvc")
 class SchedulerService:
     def __init__(self, engine: SchedulerEngine,
                  registry: RegistryClient | TelemetryRegistry,
-                 replay: bool = True, **dispatcher_kw):
+                 replay: bool = True, healthwatch=None, **dispatcher_kw):
+        """``healthwatch``: None/False = no liveness plane (pre-health
+        behavior); True = a default :class:`HealthWatch` over
+        ``registry``; or pass a configured instance."""
         self.engine = engine
         self.registry = registry
         self.dispatcher = Dispatcher(
             engine, registry,
             sync=lambda: sync_engine_from_registry(engine, registry),
             **dispatcher_kw)
+        if healthwatch is True:
+            healthwatch = HealthWatch(registry)
+        self.healthwatch: HealthWatch | None = healthwatch or None
+        if self.healthwatch is not None:
+            self.dispatcher.attach_healthwatch(self.healthwatch)
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
 
@@ -62,7 +77,11 @@ class SchedulerService:
                  uid: str = "") -> tuple[int, dict]:
         """Submit + one synchronous dispatch attempt. Returns
         (http_status, body)."""
-        key = self.dispatcher.submit(namespace, name, labels, uid=uid)
+        try:
+            key = self.dispatcher.submit(namespace, name, labels, uid=uid)
+        except Overloaded as e:
+            return 429, {"status": "overloaded", "reason": e.reason,
+                         "message": str(e)}
         self.dispatcher.step()
         status = self.dispatcher.status(key)
         state = status.get("status")
@@ -70,6 +89,8 @@ class SchedulerService:
             return 200, status
         if state in ("parked", "pending"):
             return 202, status
+        if state == "overloaded":
+            return 429, status
         return 409, status
 
     def pod_status(self, key: str) -> dict:
@@ -87,6 +108,23 @@ class SchedulerService:
         eng = self.engine
         with self.dispatcher.lock:  # the loop thread mutates continuously
             return self._state_locked(eng)
+
+    def health(self) -> dict:
+        """Liveness view for ``GET /health`` / ``kubeshare-top --health``."""
+        d = self.dispatcher
+        with d.lock:
+            nodes = (self.healthwatch.snapshot(d._clock())
+                     if self.healthwatch is not None else {})
+            return {
+                "enabled": self.healthwatch is not None,
+                "nodes": nodes,
+                "quarantined": sorted(self.engine.health_veto),
+                "evicted_total": (self.healthwatch.evicted_total
+                                  if self.healthwatch else 0),
+                "shed_total": d.shed_total,
+                "pending": len(d._pending),
+                "max_pending": d.max_pending,
+            }
 
     def render_metrics(self) -> str:
         """Scheduler-side Prometheus exposition (the reference's only
@@ -179,6 +217,8 @@ class SchedulerService:
                     return
                 if self.path == "/state":
                     return self._reply(200, svc.state())
+                if self.path == "/health":
+                    return self._reply(200, svc.health())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -253,6 +293,15 @@ def main(argv=None) -> None:
                         default=C.REGISTRY_PORT)
     parser.add_argument("--port", type=int, default=C.SCHEDULER_PORT)
     parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--max-pending", type=int, default=0,
+                        help="bounded admission queue: shed new pods past "
+                             "this many pending (0 = unbounded)")
+    parser.add_argument("--health", action="store_true",
+                        help="enable the lease-driven health plane "
+                             "(detection -> eviction -> reschedule)")
+    parser.add_argument("--lease-ttl", type=float, default=C.LEASE_TTL_S,
+                        help="heartbeat lease TTL the healthwatch assumes "
+                             "for nodes that did not declare one")
     parser.add_argument("--config", default="",
                         help="optional topology YAML (auto-derived from "
                              "discovery when omitted); the file is watched "
@@ -263,7 +312,11 @@ def main(argv=None) -> None:
     config = load_config(args.config) if args.config else None
     engine = SchedulerEngine(config=config)
     registry = RegistryClient(args.registry_host, args.registry_port)
-    svc = SchedulerService(engine, registry)
+    svc = SchedulerService(
+        engine, registry,
+        healthwatch=(HealthWatch(registry, ttl_s=args.lease_ttl)
+                     if args.health else None),
+        max_pending=args.max_pending or None)
     svc.serve(args.host, args.port)
     watcher = ConfigWatcher(args.config).start() if args.config else None
     print("READY", flush=True)

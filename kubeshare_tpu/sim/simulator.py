@@ -78,7 +78,9 @@ class SimStats:
     failed: int = 0
     retries: int = 0
     preemptions: int = 0
-    restarts: int = 0        # re-placements of preempted victims
+    restarts: int = 0        # re-placements of preempted/evicted victims
+    node_failures: int = 0   # health flips injected by the failure schedule
+    health_evictions: int = 0  # jobs thrown off a failed node
     total_wait_s: float = 0.0
     chip_seconds: float = 0.0
     makespan_s: float = 0.0
@@ -93,6 +95,8 @@ class SimStats:
             "submitted": self.submitted, "placed": self.placed,
             "failed": self.failed, "retries": self.retries,
             "preemptions": self.preemptions, "restarts": self.restarts,
+            "node_failures": self.node_failures,
+            "health_evictions": self.health_evictions,
             "mean_wait_s": round(self.mean_wait_s, 3),
             "chip_seconds": round(self.chip_seconds, 1),
             "makespan_s": round(self.makespan_s, 1),
@@ -112,7 +116,7 @@ class Simulator:
 
     def __init__(self, engine: SchedulerEngine, seed: int = 0,
                  namespace: str = "sim", preempt: bool = False,
-                 label_fn=None):
+                 label_fn=None, failures: list | None = None):
         self.engine = engine
         self.rng = random.Random(seed)
         self.namespace = namespace
@@ -120,6 +124,12 @@ class Simulator:
         #: displaces opportunistic filler (fewest-victim plan); victims
         #: restart from scratch via the pending queue
         self.preempt = preempt
+        #: node-failure schedule, ``[(fail_at_s, node, down_for_s), ...]``
+        #: — the health plane's detection->eviction->reschedule arc in
+        #: virtual time (doc/health.md): at fail_at the node goes
+        #: unhealthy and its jobs are evicted to the pending queue; at
+        #: fail_at + down_for it recovers and the queue retries
+        self.failures = list(failures or [])
         #: labels per job — defaults to the reference synthesis rule;
         #: override to mix in guarantee priorities for preemption runs
         self.label_fn = label_fn or synthesize_labels
@@ -142,6 +152,12 @@ class Simulator:
         for job in jobs:
             submit_time += job.offset_s
             heapq.heappush(events, (submit_time, seq, "submit", job))
+            seq += 1
+        for fail_at, node, down_for in self.failures:
+            heapq.heappush(events, (float(fail_at), seq, "fail", node))
+            seq += 1
+            heapq.heappush(events, (float(fail_at) + float(down_for), seq,
+                                    "recover", node))
             seq += 1
         pending: list[tuple[str, TraceJob, float]] = []
         now = 0.0
@@ -198,6 +214,15 @@ class Simulator:
             seq += 1
             return True
 
+        def retry_pending() -> None:
+            nonlocal pending
+            still_pending = []
+            for name, job, submitted_at in pending:
+                self.stats.retries += 1
+                if not try_place(name, job, submitted_at):
+                    still_pending.append((name, job, submitted_at))
+            pending = still_pending
+
         while events:
             now, _, kind, payload = heapq.heappop(events)
             if kind == "submit":
@@ -206,6 +231,29 @@ class Simulator:
                 self.stats.submitted += 1
                 if not try_place(name, job, now):
                     pending.append((name, job, now))
+            elif kind == "fail":
+                # the healthwatch arc in virtual time: node dead -> its
+                # jobs evicted to the queue, capacity withheld until
+                # recovery (detection latency is below the sim's
+                # event-granularity; the live plane's is benched in
+                # scripts/bench_health.py)
+                self.stats.node_failures += 1
+                self.engine.set_node_health(payload, False)
+                for vkey, entry in [(k, e) for k, e in self._live.items()
+                                    if self.engine.pod_status[k].node_name
+                                    == payload]:
+                    del self._live[vkey]
+                    self.engine.delete_pod(vkey)
+                    self._evicted[vkey] = self._evicted.get(vkey, 0) + 1
+                    self.stats.health_evictions += 1
+                    vname, vjob, _, placed_at, vreq = entry
+                    # only the executed slice delivered chip-seconds
+                    self.stats.chip_seconds += vreq * (now - placed_at)
+                    pending.append((vname, vjob, now))
+                retry_pending()  # survivors may absorb the refugees
+            elif kind == "recover":
+                self.engine.set_node_health(payload, True)
+                retry_pending()
             else:
                 if self._evicted.get(payload):
                     # the victim was preempted: its old completion event
@@ -221,12 +269,7 @@ class Simulator:
                     _, cjob, _, _, creq = entry
                     self.stats.chip_seconds += creq * cjob.runtime_s
                 self.engine.delete_pod(payload)
-                still_pending = []
-                for name, job, submitted_at in pending:
-                    self.stats.retries += 1
-                    if not try_place(name, job, submitted_at):
-                        still_pending.append((name, job, submitted_at))
-                pending = still_pending
+                retry_pending()
         self.stats.failed = len(pending)
         for name, _, _ in pending:
             self.engine.delete_pod(f"{self.namespace}/{name}")
@@ -256,6 +299,12 @@ def main(argv=None) -> None:
                         help="model dispatcher preemption: blocked "
                              "guarantee jobs displace opportunistic "
                              "filler; victims restart from scratch")
+    parser.add_argument("--fail", action="append", default=[],
+                        metavar="NODE@T:DOWN",
+                        help="inject a node failure: NODE goes unhealthy "
+                             "at T seconds (virtual) and recovers DOWN "
+                             "seconds later; its jobs are evicted and "
+                             "requeued (repeatable)")
     parser.add_argument("--guarantee-frac", type=float, default=0.0,
                         help="fraction of jobs upgraded to guarantee "
                              "priority 50 (the canonical synthesis is "
@@ -284,8 +333,16 @@ def main(argv=None) -> None:
             if rng.random() < _f:
                 labels[C.POD_PRIORITY] = "50"
             return labels
+    failures = []
+    for spec in args.fail:
+        try:
+            node, _, rest = spec.partition("@")
+            at, _, down = rest.partition(":")
+            failures.append((float(at), node, float(down)))
+        except ValueError:
+            parser.error(f"--fail wants NODE@T:DOWN, got {spec!r}")
     stats = Simulator(engine, seed=args.seed, preempt=args.preempt,
-                      label_fn=label_fn).run(jobs)
+                      label_fn=label_fn, failures=failures).run(jobs)
     print(json.dumps(stats.to_json()))
 
 

@@ -9,10 +9,11 @@ for observability. See :mod:`.registry`, :mod:`.collector`,
 from .aggregator import (publish_binding, requirement_record,
                          sync_engine_from_registry, withdraw)
 from .collector import CapacityCollector
+from .heartbeat import Heartbeater
 from .registry import RegistryClient, TelemetryRegistry
 
 __all__ = [
-    "CapacityCollector", "RegistryClient", "TelemetryRegistry",
-    "publish_binding", "requirement_record", "sync_engine_from_registry",
-    "withdraw",
+    "CapacityCollector", "Heartbeater", "RegistryClient",
+    "TelemetryRegistry", "publish_binding", "requirement_record",
+    "sync_engine_from_registry", "withdraw",
 ]

@@ -22,9 +22,11 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import constants as C
 from ..obs.metrics import render_default, render_help_type
 from ..topology.discovery import discover_chips
 from ..utils.logger import get_logger
+from .heartbeat import Heartbeater
 from .registry import RegistryClient, render_metric
 
 log = get_logger("collector")
@@ -37,13 +39,20 @@ class CapacityCollector:
     """Discovers local chips and pushes them to the registry."""
 
     def __init__(self, registry: RegistryClient, node: str | None = None,
-                 backend: str = "auto", period_s: float = DEFAULT_PERIOD_S):
+                 backend: str = "auto", period_s: float = DEFAULT_PERIOD_S,
+                 lease_ttl_s: float = C.LEASE_TTL_S):
         from ..utils import default_node_name
 
         self.registry = registry
         self.node = node or default_node_name()
         self.backend = backend
         self.period_s = period_s
+        # liveness rides with the collector: capacity says WHAT the node
+        # offers, the lease says it is still THERE (doc/health.md keeps
+        # the two axes independent). 0 disables the heartbeat.
+        self.heartbeat = (Heartbeater(registry, self.node,
+                                      ttl_s=lease_ttl_s)
+                          if lease_ttl_s > 0 else None)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.last_chips: list = []
@@ -53,6 +62,8 @@ class CapacityCollector:
         logged, not raised — the next period retries (an unreachable
         registry must not kill the loop and leave the node's entry
         permanently stale)."""
+        if self.heartbeat is not None:
+            self.heartbeat.beat_once()
         try:
             chips = discover_chips(self.backend, host=self.node)
         except Exception as e:
@@ -81,14 +92,22 @@ class CapacityCollector:
         self._thread = threading.Thread(target=self.run_forever, daemon=True,
                                         name=f"collector-{self.node}")
         self._thread.start()
+        if self.heartbeat is not None:
+            # the lease beats on its own cadence (TTL/3), faster than the
+            # 5 s capacity period — liveness detection must not wait for
+            # a full discovery pass
+            self.heartbeat.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
         try:
             self.registry.drop_capacity(self.node)
+            self.registry.drop_lease(self.node)
         except Exception:
             pass
 

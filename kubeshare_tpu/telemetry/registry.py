@@ -22,7 +22,18 @@ HTTP API (JSON bodies):
 - ``PUT  /pods/<ns>/<name>``   requirement record (see aggregator)
 - ``GET  /pods[?node=X]``      {key: record}
 - ``DELETE /pods/<ns>/<name>``
+- ``PUT  /lease/<node>``       {"epoch": int, "ttl_s": float} → 200 ok,
+  409 + current epoch when the epoch is stale (zombie publisher)
+- ``GET  /leases``             {"now": server_ts, "leases": {node: {...}}}
+  — ``now`` is the registry's clock so agents can measure skew
 - ``GET  /metrics``            Prometheus exposition (capacity+requirement)
+
+**Leases** (doc/health.md): node agents heartbeat ``put_lease`` with a
+monotonically increasing epoch; ``stale_nodes(now)`` lists nodes whose
+lease age exceeds its TTL. Lease epochs are journaled, but on replay
+each lease's timestamp is reset to construction time — a registry
+restart grants the fleet one full TTL of grace instead of mass-expiring
+every node that beat while the registry was down.
 
 **Durability**: pass ``journal=<path>`` and every mutation is appended to
 a JSONL journal (compacted to a snapshot every ``compact_every`` writes),
@@ -64,10 +75,14 @@ class TelemetryRegistry:
     """In-memory cluster state with an HTTP surface."""
 
     def __init__(self, journal: str | os.PathLike | None = None,
-                 compact_every: int = 1000):
+                 compact_every: int = 1000, clock=time.time):
         self._lock = threading.Lock()
+        self._clock = clock
         self._capacity: dict[str, dict] = {}
         self._pods: dict[str, dict] = {}
+        #: node -> {"epoch", "ttl_s", "ts"}; ts is ALWAYS this registry's
+        #: clock (set at receive / replay), never the publisher's
+        self._leases: dict[str, dict] = {}
         self._server: ThreadingHTTPServer | None = None
         self._journal_path = Path(journal) if journal else None
         self._journal = None
@@ -122,6 +137,16 @@ class TelemetryRegistry:
             self._pods[rec["key"]] = rec["record"]
         elif op == "drop_pod":
             self._pods.pop(rec["key"], None)
+        elif op == "put_lease":
+            # epochs survive the restart (zombie protection stays armed);
+            # the timestamp is reset to NOW so every replayed lease gets
+            # one full TTL of grace — a restart must not mass-expire a
+            # fleet that kept beating while the registry was down
+            self._leases[rec["node"]] = {"epoch": int(rec["epoch"]),
+                                         "ttl_s": float(rec["ttl_s"]),
+                                         "ts": self._clock()}
+        elif op == "drop_lease":
+            self._leases.pop(rec["node"], None)
         else:
             raise KeyError(op)
 
@@ -152,6 +177,10 @@ class TelemetryRegistry:
             for key, record in self._pods.items():
                 fh.write(json.dumps({"op": "put_pod", "key": key,
                                      "record": record}) + "\n")
+            for node, lease in self._leases.items():
+                fh.write(json.dumps({"op": "put_lease", "node": node,
+                                     "epoch": lease["epoch"],
+                                     "ttl_s": lease["ttl_s"]}) + "\n")
             fh.flush()
             os.fsync(fh.fileno())
         old = self._journal
@@ -177,7 +206,8 @@ class TelemetryRegistry:
     def put_capacity(self, node: str, chips: list[dict],
                      healthy: bool = True) -> None:
         with self._lock:
-            entry = {"chips": chips, "healthy": healthy, "ts": time.time()}
+            entry = {"chips": chips, "healthy": healthy,
+                     "ts": self._clock()}
             self._capacity[node] = entry
             self._log({"op": "put_capacity", "node": node, **entry})
 
@@ -192,7 +222,7 @@ class TelemetryRegistry:
 
     def put_pod(self, key: str, record: dict) -> None:
         with self._lock:
-            rec = dict(record, ts=time.time())
+            rec = dict(record, ts=self._clock())
             self._pods[key] = rec
             self._log({"op": "put_pod", "key": key, "record": rec})
 
@@ -207,6 +237,50 @@ class TelemetryRegistry:
         if node is None:
             return items
         return {k: v for k, v in items.items() if v.get("node") == node}
+
+    # -- liveness leases (doc/health.md) -----------------------------------
+
+    def put_lease(self, node: str, epoch: int,
+                  ttl_s: float = 5.0) -> tuple[bool, int]:
+        """One heartbeat. Epochs must be STRICTLY monotonic per node: a
+        beat at or below the recorded epoch is refused — it comes from a
+        zombie publisher (the pre-restart agent, or one cut off by a
+        partition that a replacement already superseded; a live agent
+        increments every beat, so equality can only be a second
+        publisher racing on the same epoch). Returns
+        ``(accepted, current_epoch)``."""
+        epoch = int(epoch)
+        with self._lock:
+            cur = self._leases.get(node)
+            if cur is not None and epoch <= cur["epoch"]:
+                return False, cur["epoch"]
+            lease = {"epoch": epoch, "ttl_s": float(ttl_s),
+                     "ts": self._clock()}
+            self._leases[node] = lease
+            self._log({"op": "put_lease", "node": node, "epoch": epoch,
+                       "ttl_s": lease["ttl_s"]})
+            return True, epoch
+
+    def leases(self, now: float | None = None) -> dict[str, dict]:
+        """{node: {"epoch", "ttl_s", "ts", "age_s"}} — age computed on
+        the registry clock, so consumers never compare clocks."""
+        with self._lock:
+            if now is None:
+                now = self._clock()
+            return {node: dict(lease, age_s=max(0.0, now - lease["ts"]))
+                    for node, lease in self._leases.items()}
+
+    def stale_nodes(self, now: float | None = None) -> list[str]:
+        """Nodes whose lease age exceeds its TTL (suspect or worse)."""
+        return sorted(node for node, lease in self.leases(now).items()
+                      if lease["age_s"] > lease["ttl_s"])
+
+    def drop_lease(self, node: str) -> None:
+        """Forget a node's lease (a decommission, not a death — the
+        healthwatch stops monitoring it entirely)."""
+        with self._lock:
+            self._leases.pop(node, None)
+            self._log({"op": "drop_lease", "node": node})
 
     def render_metrics(self) -> str:
         """Prometheus exposition, reference metric shapes
@@ -228,6 +302,15 @@ class TelemetryRegistry:
             ns, _, name = key.partition("/")
             labels.update({"namespace": ns, "pod": name})
             lines.append(render_metric("tpu_requirement", labels, rec["ts"]))
+        leases = self.leases()
+        if leases:
+            lines.extend(render_help_type(
+                "kubeshare_lease_age_seconds", "gauge",
+                "Seconds since the node's last heartbeat lease, on the "
+                "registry clock."))
+            for node, lease in sorted(leases.items()):
+                lines.append(render_metric("kubeshare_lease_age_seconds",
+                                           {"node": node}, lease["age_s"]))
         return "\n".join(lines) + "\n" + obs_metrics.render_default()
 
     # -- HTTP server -------------------------------------------------------
@@ -266,6 +349,11 @@ class TelemetryRegistry:
                         qs = parse_qs(self.path.split("?", 1)[1])
                         node = (qs.get("node") or [None])[0]
                     return self._json(registry.pods(node))
+                if path == "/leases":
+                    # server time in the body: doctor's clock-skew check
+                    # compares it against the agent's local clock
+                    return self._json({"now": registry._clock(),
+                                       "leases": registry.leases()})
                 if path == "/metrics":
                     return self._reply(200, registry.render_metrics().encode(),
                                        "text/plain; version=0.0.4")
@@ -283,6 +371,15 @@ class TelemetryRegistry:
                 if len(parts) == 3 and parts[0] == "pods":
                     registry.put_pod(f"{parts[1]}/{parts[2]}", self._body())
                     return self._json({"ok": True})
+                if len(parts) == 2 and parts[0] == "lease":
+                    body = self._body()
+                    ok, epoch = registry.put_lease(
+                        parts[1], int(body.get("epoch", 0)),
+                        float(body.get("ttl_s", 5.0)))
+                    if not ok:
+                        return self._reply(409, json.dumps(
+                            {"ok": False, "epoch": epoch}).encode())
+                    return self._json({"ok": True, "epoch": epoch})
                 self._reply(404, b"{}")
 
             do_POST = do_PUT
@@ -294,6 +391,9 @@ class TelemetryRegistry:
                     return self._json({"ok": True})
                 if len(parts) == 3 and parts[0] == "pods":
                     registry.drop_pod(f"{parts[1]}/{parts[2]}")
+                    return self._json({"ok": True})
+                if len(parts) == 2 and parts[0] == "lease":
+                    registry.drop_lease(parts[1])
                     return self._json({"ok": True})
                 self._reply(404, b"{}")
 
@@ -347,6 +447,12 @@ class RegistryClient:
                 time.sleep(self.RETRY_BACKOFF_S * (2 ** (attempt - 1))
                            * (0.5 + random.random()))
             try:
+                # control-plane fault drill: a partitioned registry looks
+                # exactly like a transport failure (resilience/faults.py)
+                from ..resilience import faults as _faults
+                inj = _faults.active()
+                if inj is not None and inj.should_partition_registry():
+                    raise OSError("injected registry partition")
                 with self._open(req, timeout=self._timeout) as resp:
                     return resp.read()
             except urllib.error.HTTPError:
@@ -389,6 +495,28 @@ class RegistryClient:
 
     def drop_pod(self, key: str) -> None:
         self._request("DELETE", f"/pods/{key}")
+
+    def put_lease(self, node: str, epoch: int,
+                  ttl_s: float = 5.0) -> tuple[bool, int]:
+        """Heartbeat; returns ``(accepted, current_epoch)``. A 409 means
+        a newer epoch exists — the caller should jump past it."""
+        try:
+            body = self._request("PUT", f"/lease/{node}",
+                                 {"epoch": int(epoch),
+                                  "ttl_s": float(ttl_s)})
+        except urllib.error.HTTPError as exc:
+            if exc.code == 409:
+                detail = json.loads(exc.read() or b"{}")
+                return False, int(detail.get("epoch", epoch))
+            raise
+        return True, int(body.get("epoch", epoch))
+
+    def leases(self) -> dict:
+        """``{"now": server_ts, "leases": {node: {...}}}``."""
+        return self._request("GET", "/leases")
+
+    def drop_lease(self, node: str) -> None:
+        self._request("DELETE", f"/lease/{node}")
 
     def metrics(self) -> str:
         req = urllib.request.Request(self._base + "/metrics")

@@ -12,6 +12,7 @@ Usage::
     python -m kubeshare_tpu.topcli [--registry HOST:PORT] [--node N]
                                    [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json] [--latency]
+                                   [--health]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
 ``--latency`` switches from the fleet table to the self-observability
@@ -19,6 +20,10 @@ view: phase-latency percentiles (p50/p90/p99 from the exposition's
 histogram buckets, ``doc/observability.md``) plus per-chip token
 utilization — scraped from the scheduler's ``/metrics`` when
 ``--scheduler`` is given, else the registry's.
+``--health`` renders the liveness plane (``doc/health.md``): per-node
+lease age and health state (+ time since the last transition), joined
+from the registry's ``/leases`` and — when ``--scheduler`` is given —
+the scheduler's ``/health`` (state machine, shed/evicted totals).
 Exit 0 on a healthy read, 2 when the registry is unreachable.
 """
 
@@ -103,6 +108,71 @@ def snapshot(client: RegistryClient, node: str | None = None,
                       "booked": round(booked_total, 3),
                       "pods": len(pods), "gangs": len(groups),
                       "evicting": len(evictions)}}
+
+
+def health_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Liveness join: registry leases (ground truth for age, computed on
+    the registry's clock) + scheduler health states when reachable."""
+    raw = client.leases()
+    leases = raw.get("leases", raw) if isinstance(raw, dict) else {}
+    sched: dict = {}
+    if scheduler is not None:
+        try:
+            sched = scheduler.health()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "health states unavailable, showing raw leases",
+                  file=sys.stderr)
+    states = sched.get("nodes", {})
+    nodes = []
+    for name in sorted(set(leases) | set(states)):
+        lease = leases.get(name, {})
+        st = states.get(name, {})
+        nodes.append({
+            "node": name,
+            "state": st.get("state", "unmonitored"),
+            "lease_age_s": round(float(lease.get(
+                "age_s", st.get("lease_age_s", 0.0))), 3),
+            "ttl_s": lease.get("ttl_s"),
+            "epoch": lease.get("epoch", st.get("epoch", 0)),
+            "since_s": st.get("since_s"),
+        })
+    return {"nodes": nodes,
+            "enabled": sched.get("enabled"),
+            "quarantined": sched.get("quarantined", []),
+            "evicted_total": sched.get("evicted_total", 0),
+            "shed_total": sched.get("shed_total", 0),
+            "pending": sched.get("pending"),
+            "max_pending": sched.get("max_pending")}
+
+
+def render_health(snap: dict) -> str:
+    lines = ["HEALTH (lease liveness, doc/health.md)"]
+    if not snap["nodes"]:
+        lines.append("  no leases published — node agents are not "
+                     "heartbeating (launcherd --registry-host)")
+    else:
+        lines.append(f"  {'node':<24} {'state':<12} {'lease age':>10} "
+                     f"{'ttl':>6} {'epoch':>7} {'since':>8}")
+        for n in snap["nodes"]:
+            ttl = f"{n['ttl_s']:.0f}s" if n.get("ttl_s") else "-"
+            since = (f"{n['since_s']:.0f}s" if n.get("since_s") is not None
+                     else "-")
+            lines.append(
+                f"  {n['node']:<24} {n['state']:<12} "
+                f"{n['lease_age_s']:>9.1f}s {ttl:>6} {n['epoch']:>7} "
+                f"{since:>8}")
+    if snap.get("enabled") is not None:
+        pend = (f"{snap['pending']}/{snap['max_pending']}"
+                if snap.get("max_pending") else f"{snap.get('pending', 0)}")
+        lines.append(
+            f"SCHEDULER: health plane "
+            f"{'on' if snap['enabled'] else 'off'}, "
+            f"{snap['evicted_total']} evicted, {snap['shed_total']} shed, "
+            f"pending {pend}"
+            + (", quarantined: " + ", ".join(snap["quarantined"])
+               if snap.get("quarantined") else ""))
+    return "\n".join(lines)
 
 
 def _fmt_seconds(s: float) -> str:
@@ -252,6 +322,10 @@ def main(argv=None) -> int:
                         help="phase-latency percentiles + per-chip token "
                              "utilization from /metrics instead of the "
                              "fleet table")
+    parser.add_argument("--health", action="store_true",
+                        help="per-node lease age + health state (and "
+                             "shed/evicted totals with --scheduler) "
+                             "instead of the fleet table")
     args = parser.parse_args(argv)
     host, _, port = args.registry.rpartition(":")
     client = RegistryClient(host or "127.0.0.1", int(port))
@@ -278,7 +352,10 @@ def main(argv=None) -> int:
     try:
         while True:
             try:
-                if args.latency:
+                if args.health:
+                    hs = health_snapshot(client, scheduler)
+                    out = json.dumps(hs) if args.json else render_health(hs)
+                elif args.latency:
                     lat = latency_snapshot(_fetch_exposition(metrics_url))
                     out = (json.dumps(lat) if args.json
                            else render_latency(lat, metrics_url))

@@ -1,0 +1,237 @@
+"""Health-plane micro-benchmark: what failure detection and recovery
+cost (doc/health.md).
+
+The health plane promises a bounded story: a dead node agent is
+detected within ``miss_threshold * ttl`` of its last beat, its pods are
+evicted the same poll, and they rebind as soon as the survivors can
+hold them. This bench puts numbers on each leg:
+
+- ``detection_latency_s_p50/p99``: last accepted beat → the DEAD
+  transition, in *virtual* seconds, over many kill phases (the kill
+  lands at a random offset inside the beat/poll cadence, so the
+  distribution covers the whole phase space deterministically). Driven
+  on a fake clock shared by the engine, dispatcher, registry, and
+  heartbeaters — the same harness as ``tests/test_healthwatch.py``.
+- ``evict_to_rebound_s_p50/p99``: the DEAD transition → the evicted
+  pod bound on a survivor (virtual). Eviction requeues with no
+  backoff, so this measures scheduling availability, not a sleep.
+- ``e2e_kill_to_rebound_s_p50/p99``: agent killed → pod rebound,
+  virtual end to end — the operator-facing number.
+- ``poll_cost_us_p50``: wall-clock cost of one ``HealthWatch.poll``
+  over a 16-node fleet with fresh leases — what the health plane adds
+  to every ``Dispatcher.step``.
+- ``admission_checks_per_sec``: wall-clock throughput of the bounded
+  admission gate at a full queue (the shed path's hot loop).
+
+Knobs are the defaults (ttl 5 s, miss_threshold 3, recover_k 3), so
+detection is expected between ``miss*ttl`` and
+``miss*ttl + poll_period + beat_period``.
+
+Run: ``python scripts/bench_health.py`` → one JSON object (committed
+as ``bench_health.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers (``make bench-health`` does both
+against ``bench_health.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line (the rest of the JSON is descriptive)
+_METRICS = ("detection_latency_s_p50", "detection_latency_s_p99",
+            "evict_to_rebound_s_p50", "evict_to_rebound_s_p99",
+            "e2e_kill_to_rebound_s_p50", "e2e_kill_to_rebound_s_p99",
+            "poll_cost_us_p50", "admission_checks_per_sec")
+#: metrics where larger is better (the rest are latencies)
+_HIGHER_IS_BETTER = ("admission_checks_per_sec",)
+
+TTL, MISS = 5.0, 3
+RUNS = 40
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+
+def _make_cluster(clock, hosts=2):
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+    from kubeshare_tpu.scheduler.healthwatch import HealthWatch
+    from kubeshare_tpu.telemetry import Heartbeater, TelemetryRegistry
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    eng = SchedulerEngine(clock=clock)
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=hosts, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    reg = TelemetryRegistry(clock=clock)
+    disp = Dispatcher(eng, reg, clock=clock, retry_backoff_s=1.0)
+    hw = HealthWatch(reg, ttl_s=TTL, miss_threshold=MISS)
+    disp.attach_healthwatch(hw)
+    beaters = {n: Heartbeater(reg, n, ttl_s=TTL)
+               for n in eng.chips_by_node}
+    return eng, reg, disp, hw, beaters
+
+
+def _one_arc(seed: int) -> tuple[float, float, float]:
+    """One kill→detect→evict→rebound arc on the fake clock; returns
+    (detection_s, evict_to_rebound_s, e2e_s) in virtual seconds."""
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.scheduler.healthwatch import DEAD
+
+    rng = random.Random(seed)
+    clock = _Clock()
+    eng, reg, disp, hw, beaters = _make_cluster(clock)
+    for hb in beaters.values():
+        hb.beat_once()
+    key = disp.submit("bench", "pod",
+                      {C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"})
+    disp.step()
+    victim = disp.outcome(key).binding.node
+
+    # let the cadence settle, then kill at a random phase offset
+    dt = 0.25
+    for _ in range(int(rng.uniform(0.0, TTL) / dt) + 1):
+        clock.t += dt
+        for hb in beaters.values():
+            hb.beat_once()
+        disp.step()
+    killed_at = clock.t
+    last_beat = reg.leases()[victim]["ts"]
+
+    dead_at = rebound_at = None
+    while clock.t < killed_at + MISS * TTL + 4 * TTL:
+        clock.t += dt
+        for node, hb in beaters.items():
+            if node != victim:              # the victim's agent is dead
+                hb.beat_once()
+        disp.step()
+        if dead_at is None and hw.nodes[victim].state == DEAD:
+            dead_at = clock.t
+        out = disp.outcome(key)
+        if (dead_at is not None and rebound_at is None and out is not None
+                and out.status == "bound" and out.binding.node != victim):
+            rebound_at = clock.t
+            break
+    assert dead_at is not None and rebound_at is not None, \
+        f"arc did not complete (seed {seed})"
+    return (dead_at - last_beat, rebound_at - dead_at,
+            rebound_at - killed_at)
+
+
+def run_bench() -> dict:
+    out: dict = {"bench": "health plane: detection, eviction, rebound "
+                          "(virtual clock) + poll/admission cost (wall)",
+                 "ttl_s": TTL, "miss_threshold": MISS, "runs": RUNS}
+
+    detect, rebound, e2e = [], [], []
+    for seed in range(RUNS):
+        d, r, e = _one_arc(seed)
+        detect.append(d)
+        rebound.append(r)
+        e2e.append(e)
+    out["detection_latency_s_p50"] = round(statistics.median(detect), 2)
+    out["detection_latency_s_p99"] = round(_percentile(detect, 0.99), 2)
+    out["evict_to_rebound_s_p50"] = round(statistics.median(rebound), 2)
+    out["evict_to_rebound_s_p99"] = round(_percentile(rebound, 0.99), 2)
+    out["e2e_kill_to_rebound_s_p50"] = round(statistics.median(e2e), 2)
+    out["e2e_kill_to_rebound_s_p99"] = round(_percentile(e2e, 0.99), 2)
+
+    # --- wall-clock: one poll over a 16-node fleet ----------------------
+    from kubeshare_tpu.scheduler.healthwatch import HealthWatch
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+
+    clock = _Clock()
+    reg = TelemetryRegistry(clock=clock)
+    for i in range(16):
+        reg.put_lease(f"node-{i}", 1, ttl_s=TTL)
+    hw = HealthWatch(reg, ttl_s=TTL, poll_period_s=0.0)
+    costs = []
+    for i in range(2000):
+        clock.t += 0.001
+        t0 = time.perf_counter()
+        hw.poll(clock.t)
+        costs.append((time.perf_counter() - t0) * 1e6)
+    out["poll_cost_us_p50"] = round(statistics.median(costs), 1)
+
+    # --- wall-clock: admission gate at a full queue ---------------------
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.scheduler.dispatcher import Overloaded
+
+    huge = {C.POD_TPU_REQUEST: "8", C.POD_TPU_LIMIT: "8"}
+    clock2 = _Clock()
+    eng, _, disp, _, _ = _make_cluster(clock2)
+    disp.max_pending = 64
+    for i in range(64):                     # 8-chip asks never place
+        disp.submit(f"ns{i % 4}", f"p{i}", huge)
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        try:
+            disp.submit("fresh", f"x{i}", huge)
+        except Overloaded:
+            pass
+    out["admission_checks_per_sec"] = round(n / (time.perf_counter() - t0))
+    return out
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:28s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:28s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="bench_health")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,7 +54,8 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
                       "--scheduler", "127.0.0.1:1"])
     out = capsys.readouterr().out
     assert rc == 1
-    assert out.count("fail") == 2
+    # registry + scheduler + leases (the health plane's wire) all refuse
+    assert out.count("fail") == 3
 
 
 def test_doctor_cli_subprocess():
@@ -120,4 +121,5 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
                       "--scheduler", f"127.0.0.1:{ports[1]}"])
     out = capsys.readouterr().out
     assert rc == 1, out
-    assert out.count("fail") == 2, out
+    # registry + scheduler + leases (the health plane's wire) all refuse
+    assert out.count("fail") == 3, out

@@ -1,0 +1,427 @@
+"""Health-plane tests: lease liveness -> detection -> eviction ->
+gang-aware rescheduling, plus overload shedding and deadlines
+(doc/health.md).
+
+Everything is driven through ``Dispatcher.step`` with a fake clock
+shared by the engine, the dispatcher, AND the telemetry registry (lease
+ages are computed on the registry clock), so the whole
+detection→eviction→rebound arc is deterministic.
+"""
+
+import random
+
+import pytest
+
+from kubeshare_tpu import constants as C
+from kubeshare_tpu.obs.trace import Tracer, install_tracer, uninstall_tracer
+from kubeshare_tpu.resilience.faults import FaultSpec, Injector, install
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.dispatcher import Dispatcher, Overloaded
+from kubeshare_tpu.scheduler.healthwatch import (DEAD, QUARANTINED, SUSPECT,
+                                                 UP, HealthWatch)
+from kubeshare_tpu.telemetry import Heartbeater, TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+TTL = 5.0
+MISS = 3          # dead after 15 s of silence
+RECOVER_K = 2
+QUARANTINE = 10.0
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_engine(hosts=1, mesh=(2, 2), clock=None):
+    eng = SchedulerEngine(**({"clock": clock} if clock else {}))
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=hosts, mesh=mesh).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    return eng
+
+
+def shared(request="0.5", limit="1.0", **extra):
+    labels = {C.POD_TPU_REQUEST: request, C.POD_TPU_LIMIT: limit}
+    labels.update(extra)
+    return labels
+
+
+def gang(name, headcount=2, threshold=1.0, priority="10", **kw):
+    return shared(**{C.POD_GROUP_NAME: name,
+                     C.POD_GROUP_HEADCOUNT: str(headcount),
+                     C.POD_GROUP_THRESHOLD: str(threshold),
+                     C.POD_PRIORITY: priority}, **kw)
+
+
+class Cluster:
+    """Engine + registry + dispatcher + healthwatch + one heartbeater per
+    node, all on one fake clock."""
+
+    def __init__(self, clock, hosts=2, mesh=(2, 2), **disp_kw):
+        self.clock = clock
+        self.engine = make_engine(hosts=hosts, mesh=mesh, clock=clock)
+        self.registry = TelemetryRegistry(clock=clock)
+        self.disp = Dispatcher(self.engine, self.registry, clock=clock,
+                               retry_backoff_s=1.0, **disp_kw)
+        self.hw = HealthWatch(self.registry, ttl_s=TTL,
+                              miss_threshold=MISS, recover_k=RECOVER_K,
+                              quarantine_s=QUARANTINE)
+        self.disp.attach_healthwatch(self.hw)
+        self.beaters = {
+            node: Heartbeater(self.registry, node, ttl_s=TTL)
+            for node in self.engine.chips_by_node}
+        self.beat_all()
+
+    def beat_all(self):
+        for hb in self.beaters.values():
+            hb.beat_once()
+
+    def run(self, seconds, dt=1.0, beat=True):
+        """Advance virtual time; heartbeats go through the fault
+        injector, so a suppressed node is silent exactly like a dead
+        agent."""
+        end = self.clock.t + seconds
+        while self.clock.t < end:
+            self.clock.t += dt
+            if beat:
+                self.beat_all()
+            self.disp.step()
+
+    def state(self, node):
+        st = self.hw.nodes.get(node)
+        return st.state if st else None
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture(autouse=True)
+def _no_injector():
+    yield
+    install(None)
+
+
+# -- the acceptance arc: kill agent -> dead -> withheld -> rebound ------------
+
+
+def test_killed_agent_evicts_and_rebinds_on_survivor(clock):
+    tracer = install_tracer(Tracer())
+    try:
+        cl = Cluster(clock, hosts=2)
+        key = cl.disp.submit("ns", "p", shared())
+        cl.disp.step()
+        victim = cl.disp.outcome(key).binding.node
+        survivor = next(n for n in cl.beaters if n != victim)
+
+        # the node agent dies: heartbeats suppressed via the injector
+        install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+        cl.run(MISS * TTL + 2 * TTL)  # past miss_threshold*ttl + slack
+
+        # dead within miss_threshold*ttl (+ one poll period of slack)
+        st = cl.hw.nodes[victim]
+        assert st.state == DEAD
+        dead_at = st.last_transition
+        assert dead_at - 100.0 <= MISS * TTL + cl.hw.poll_period_s + TTL
+
+        # capacity withheld: the engine vetoes the node out of scoring
+        assert victim in cl.engine.health_veto
+        assert cl.engine.node_health[victim] is False
+
+        # the bound pod was evicted, requeued, and rebound on the survivor
+        out = cl.disp.outcome(key)
+        assert out.status == "bound" and out.binding.node == survivor
+        assert cl.hw.evicted_total == 1
+
+        # the full sequence is visible as spans on the pod's trace
+        names = {s.name for s in tracer.spans()}
+        assert "node-lost-evict" in names
+        evict = [s for s in tracer.spans() if s.name == "node-lost-evict"][0]
+        assert evict.attrs["node"] == victim
+    finally:
+        uninstall_tracer()
+
+
+def test_suspect_is_free_one_beat_recovers(clock):
+    cl = Cluster(clock)
+    victim = next(iter(cl.beaters))
+    install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+    cl.run(TTL + 2.0)                       # past ttl, below miss*ttl
+    assert cl.state(victim) == SUSPECT
+    install(None)                           # the beat arrives after all
+    cl.run(TTL)                             # ≥ one poll period
+    assert cl.state(victim) == UP
+    assert cl.hw.evicted_total == 0         # nothing was evicted
+
+
+def test_recovery_needs_streak_and_quarantine_hold(clock):
+    """A dead node that beats again is quarantined (still vetoed), and
+    only recovers after recover_k beats AND quarantine_s of hold."""
+    cl = Cluster(clock, hosts=2)
+    victim = next(iter(cl.beaters))
+    install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+    cl.run(MISS * TTL + 2 * TTL)
+    assert cl.state(victim) == DEAD
+
+    install(None)                           # the agent comes back
+    cl.run(TTL)                             # ≥ one poll period
+    assert cl.state(victim) == QUARANTINED
+    assert victim in cl.engine.health_veto  # still withheld
+    cl.run(QUARANTINE + TTL)                # streak + hold both satisfied
+    assert cl.state(victim) == UP
+    assert victim not in cl.engine.health_veto
+    assert cl.engine.node_health[victim] is True
+
+
+def test_gang_evicted_whole_on_one_dead_member(clock):
+    """One dead member re-plans the WHOLE gang: no half-dead gang keeps
+    chips reserved on the survivors."""
+    cl = Cluster(clock, hosts=2, mesh=(2,))  # 2 whole-chip leaves/node
+    k0 = cl.disp.submit("ns", "g-0", gang("g", request="1", limit="1"))
+    k1 = cl.disp.submit("ns", "g-1", gang("g", request="1", limit="1"))
+    cl.disp.step()
+    assert cl.disp.outcome(k0).status == "bound"
+    nodes_before = {cl.disp.outcome(k).binding.node for k in (k0, k1)}
+
+    victim = cl.disp.outcome(k0).binding.node
+    install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+    cl.run(MISS * TTL + 2 * TTL)
+    assert cl.state(victim) == DEAD
+    # both members rebound, neither on the dead node
+    for k in (k0, k1):
+        out = cl.disp.outcome(k)
+        assert out.status == "bound"
+        assert out.binding.node != victim
+    # nothing remains reserved for the gang on the dead node
+    for pod in cl.engine.pod_status.values():
+        assert pod.node_name != victim
+    assert nodes_before  # (sanity: the gang was placed at all)
+
+
+# -- satellite: status reason + capacity/health independence ------------------
+
+
+def test_status_reports_node_lost_reason(clock):
+    """Single-node fleet: after eviction nothing can host the pod, so
+    its pending status must say WHY: the node was lost."""
+    cl = Cluster(clock, hosts=1)
+    key = cl.disp.submit("ns", "p", shared())
+    cl.disp.step()
+    victim = cl.disp.outcome(key).binding.node
+    install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+    cl.run(MISS * TTL + 2 * TTL)
+    st = cl.disp.status(key)
+    assert st["status"] == "pending"
+    assert "node lost" in st["reason"]
+    assert st["evicted_from"] == victim
+
+
+def test_put_capacity_does_not_resurrect_quarantined_node(clock):
+    """Capacity and health are independent axes: a capacity re-put (the
+    collector publishing fresh chips) must NOT clear the health veto."""
+    cl = Cluster(clock, hosts=1)
+    victim = next(iter(cl.beaters))
+    install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+    cl.run(MISS * TTL + 2 * TTL)
+    assert victim in cl.engine.health_veto
+
+    # the node's collector is still alive and re-puts capacity
+    chips = [c for c in FakeTopology(hosts=1, mesh=(2, 2)).chips()
+             if c.host == victim]
+    cl.engine.add_node(victim, chips)
+    assert cl.engine.node_health[victim] is False     # still vetoed
+    # and a pod still cannot land there
+    key = cl.disp.submit("ns", "late", shared())
+    cl.disp.step()
+    assert cl.disp.status(key)["status"] == "pending"
+
+
+# -- overload shedding + deadlines --------------------------------------------
+
+
+def huge():
+    return shared("8", "8")   # can never fit a 2x2 mesh: stays pending
+
+
+def test_max_pending_hard_cap(clock):
+    cl = Cluster(clock, hosts=1, max_pending=3)
+    for i in range(3):
+        cl.disp.submit("ns", f"p{i}", huge())
+    with pytest.raises(Overloaded) as exc:
+        cl.disp.submit("ns", "p3", huge())
+    assert exc.value.reason == "max-pending"
+    assert cl.disp.status("ns/p3")["status"] == "overloaded"
+    assert cl.disp.shed_total == 1
+    # resubmit of a KNOWN pod is a poll, not new load — always passes
+    assert cl.disp.submit("ns", "p0", huge()) == "ns/p0"
+
+
+def test_fair_share_across_namespaces(clock):
+    cl = Cluster(clock, hosts=1, max_pending=4)
+    cl.disp.submit("team-a", "a0", huge())
+    cl.disp.submit("team-a", "a1", huge())
+    cl.disp.submit("team-b", "b0", huge())
+    # two active namespaces -> share = 4 // 2 = 2; team-a is at 2
+    with pytest.raises(Overloaded) as exc:
+        cl.disp.submit("team-a", "a2", huge())
+    assert exc.value.reason == "fair-share"
+    # team-b is under its share and still admits
+    assert cl.disp.submit("team-b", "b1", huge()) == "team-b/b1"
+
+
+def test_deadline_label_times_out_pending_pod(clock):
+    cl = Cluster(clock, hosts=1)
+    key = cl.disp.submit("ns", "p", huge() | {C.POD_DEADLINE: "10"})
+    cl.disp.step()
+    assert cl.disp.status(key)["status"] == "pending"
+    cl.run(9.0)
+    assert cl.disp.status(key)["status"] == "pending"   # not yet
+    cl.run(3.0)
+    out = cl.disp.outcome(key)
+    assert out.status == "timed-out"
+    assert key not in cl.engine.pod_status              # fully released
+
+
+# -- fuzz: random flap schedules ----------------------------------------------
+
+
+def _assert_no_double_reserve(eng):
+    booked: dict[str, float] = {}
+    for pod in eng.pod_status.values():
+        for cid, compute, _mem in pod.bookings:
+            booked[cid] = booked.get(cid, 0.0) + compute
+    for cid, total in booked.items():
+        assert total <= 1.0 + 1e-6, f"chip {cid} over-reserved: {total}"
+
+
+@pytest.mark.parametrize("seed", [1, 7, 31])
+def test_fuzz_flap_schedule_invariants(clock, seed):
+    """Random per-node flap schedules. Invariants at every tick: no chip
+    is ever double-reserved. At the end (fleet stabilized): every pod
+    that was ever evicted is rebound or terminally resolved."""
+    rng = random.Random(seed)
+    cl = Cluster(clock, hosts=3)
+    keys = [cl.disp.submit("ns", f"p{i}", shared("0.5", "1.0"))
+            for i in range(6)]
+    cl.disp.step()
+
+    # random flapping: each node beats with p=0.7 each second
+    for _ in range(120):
+        clock.t += 1.0
+        for node, hb in cl.beaters.items():
+            if rng.random() < 0.7:
+                hb.beat_once()
+        cl.disp.step()
+        _assert_no_double_reserve(cl.engine)
+
+    # stabilize: everyone beats steadily until quarantines drain
+    cl.run(QUARANTINE + MISS * TTL + 20.0)
+    _assert_no_double_reserve(cl.engine)
+    assert not cl.engine.health_veto
+    for key in keys:
+        out = cl.disp.outcome(key)
+        assert out is not None and out.status == "bound", \
+            f"{key}: {cl.disp.status(key)}"
+        assert cl.engine.pod_status[key].node_name
+
+
+def test_fuzz_kill_and_resurrect_nodes(clock):
+    """Harder schedule: whole-node deaths (long silences) interleaved
+    with recoveries; every evicted pod must eventually rebind."""
+    rng = random.Random(42)
+    cl = Cluster(clock, hosts=2)
+    keys = [cl.disp.submit("ns", f"p{i}", shared("0.5", "1.0"))
+            for i in range(4)]
+    cl.disp.step()
+
+    silenced: dict[str, float] = {}      # node -> silence ends at
+    for _ in range(200):
+        clock.t += 1.0
+        for node, hb in cl.beaters.items():
+            if node in silenced:
+                if clock.t >= silenced[node]:
+                    del silenced[node]
+                else:
+                    continue
+            elif rng.random() < 0.02:    # ~2%/s: kill for 20-60 s
+                silenced[node] = clock.t + rng.uniform(20.0, 60.0)
+                continue
+            hb.beat_once()
+        cl.disp.step()
+        _assert_no_double_reserve(cl.engine)
+
+    cl.run(QUARANTINE + MISS * TTL + 20.0)
+    assert cl.hw.evicted_total >= 1      # the schedule actually bit
+    for key in keys:
+        out = cl.disp.outcome(key)
+        assert out is not None and out.status == "bound"
+
+
+# -- migration hook -----------------------------------------------------------
+
+
+def test_eviction_tries_migration_hook_first(clock):
+    calls = []
+
+    def migrate_fn(pod, plan):
+        calls.append((pod.key, plan["node"]))
+        return True
+
+    cl = Cluster(clock, hosts=2)
+    cl.hw.migrate_fn = migrate_fn
+    key = cl.disp.submit("ns", "p", shared())
+    cl.disp.step()
+    victim = cl.disp.outcome(key).binding.node
+    install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+    cl.run(MISS * TTL + 2 * TTL)
+    assert calls and calls[0][0] == key
+    assert calls[0][1] != victim         # the plan excludes the dead node
+    out = cl.disp.outcome(key)
+    assert out.status == "bound" and out.binding.node != victim
+
+
+def test_eviction_cold_requeues_when_migration_fails(clock):
+    def migrate_fn(pod, plan):
+        raise RuntimeError("proxy unreachable")
+
+    cl = Cluster(clock, hosts=2)
+    cl.hw.migrate_fn = migrate_fn
+    key = cl.disp.submit("ns", "p", shared())
+    cl.disp.step()
+    victim = cl.disp.outcome(key).binding.node
+    install(Injector(FaultSpec(suppress_heartbeats_node=victim)))
+    cl.run(MISS * TTL + 2 * TTL)
+    out = cl.disp.outcome(key)           # fell back to the cold path
+    assert out.status == "bound" and out.binding.node != victim
+
+
+# -- control-plane partition: health freezes, nothing dies --------------------
+
+
+def test_registry_partition_freezes_health(clock):
+    """An unreachable registry is NOT node death: the watch holds state
+    (logs and returns) instead of mass-evicting the fleet."""
+    cl = Cluster(clock, hosts=2)
+    cl.run(2.0)
+    assert all(st.state == UP for st in cl.hw.nodes.values())
+
+    real_leases = cl.registry.leases
+
+    def failing_leases(now=None):
+        raise OSError("injected registry partition")
+
+    cl.registry.leases = failing_leases
+    cl.run(MISS * TTL + 2 * TTL, beat=False)   # silence + partition
+    assert all(st.state == UP for st in cl.hw.nodes.values())
+    assert cl.hw.evicted_total == 0
+
+    cl.registry.leases = real_leases           # partition heals; beats
+    cl.run(2.0)                                # resume before staleness
+    assert all(st.state == UP for st in cl.hw.nodes.values())

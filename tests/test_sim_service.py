@@ -290,3 +290,46 @@ def test_sim_no_preempt_keeps_guarantee_waiting():
                       label_fn=lambda j, r: next(labels)).run(jobs)
     assert stats.preemptions == 0
     assert stats.makespan_s == pytest.approx(1100.0)  # waited the filler out
+
+
+def test_service_health_endpoint_and_overload_429():
+    """GET /health exposes the liveness plane; a full admission queue
+    answers 429 with the typed reason (doc/health.md)."""
+    registry = TelemetryRegistry()
+    chips = FakeTopology(hosts=1, mesh=(2,)).chips()
+    registry.put_capacity("tpu-host-0", [c.to_labels() for c in chips])
+    registry.put_lease("tpu-host-0", 1)
+    svc = SchedulerService(SchedulerEngine(), registry,
+                           healthwatch=True, max_pending=1)
+    svc.serve()
+    try:
+        status, body = http("GET", svc.port, "/health")
+        assert status == 200
+        assert body["enabled"] is True and body["max_pending"] == 1
+        assert body["nodes"].get("tpu-host-0", {}).get("state") == "up"
+
+        huge = {C.POD_TPU_REQUEST: "8", C.POD_TPU_LIMIT: "8"}
+        status, _ = http("POST", svc.port, "/schedule", {
+            "namespace": "ns", "name": "q0", "labels": huge})
+        assert status == 202                       # pending, queue now full
+        status, body = http("POST", svc.port, "/schedule", {
+            "namespace": "ns", "name": "q1", "labels": huge})
+        assert status == 429
+        assert body["status"] == "overloaded"
+        assert body["reason"] == "max-pending"
+    finally:
+        svc.close()
+
+
+def test_sim_node_failure_schedule():
+    """The --fail schedule evicts a failed node's jobs and re-places
+    them after recovery; everything still completes."""
+    eng = make_engine(hosts=1, mesh=(2,))
+    jobs = [TraceJob(0.0, 1, 500.0), TraceJob(0.0, 1, 500.0)]
+    stats = Simulator(eng, failures=[(100.0, "tpu-host-0", 200.0)]).run(jobs)
+    assert stats.node_failures == 1
+    assert stats.health_evictions == 2      # both ran on the only node
+    assert stats.placed == 2 and stats.failed == 0
+    assert stats.restarts == 2              # re-placed after recovery
+    # evicted at 100, recovered at 300, full 500 s reruns -> 800
+    assert stats.makespan_s == pytest.approx(800.0)

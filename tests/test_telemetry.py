@@ -193,3 +193,117 @@ def test_journal_replay_equivalence_fuzzed(tmp_path):
     final = TelemetryRegistry(journal=j)
     assert final.capacity() == reg.capacity()
     assert final.pods() == reg.pods()
+
+
+# -- heartbeat leases (doc/health.md) -----------------------------------------
+
+
+class _TickClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_lease_epoch_monotonic():
+    reg = TelemetryRegistry(clock=_TickClock())
+    assert reg.put_lease("n0", 5) == (True, 5)
+    # a zombie publisher (lower epoch) is refused with the current epoch
+    assert reg.put_lease("n0", 3) == (False, 5)
+    assert reg.put_lease("n0", 6) == (True, 6)
+    assert reg.leases()["n0"]["epoch"] == 6
+
+
+def test_lease_staleness_on_registry_clock():
+    clock = _TickClock(100.0)
+    reg = TelemetryRegistry(clock=clock)
+    reg.put_lease("n0", 1, ttl_s=5.0)
+    reg.put_lease("n1", 1, ttl_s=60.0)
+    clock.t = 110.0  # n0 is 10s old (> 5s ttl), n1 well within 60s
+    leases = reg.leases()
+    assert leases["n0"]["age_s"] == pytest.approx(10.0)
+    assert reg.stale_nodes() == ["n0"]
+    reg.put_lease("n0", 2)  # a fresh beat resets the age
+    assert reg.stale_nodes() == []
+
+
+def test_lease_http_roundtrip(registry, client):
+    assert client.put_lease("tpu-host-0", 1, ttl_s=5.0) == (True, 1)
+    # stale epoch -> 409 carrying the current epoch (takeover hint)
+    assert client.put_lease("tpu-host-0", 0) == (False, 1)
+    body = client.leases()
+    assert isinstance(body["now"], float)
+    lease = body["leases"]["tpu-host-0"]
+    assert lease["epoch"] == 1 and lease["ttl_s"] == 5.0
+    assert lease["age_s"] < 5.0
+    client.drop_lease("tpu-host-0")
+    assert client.leases()["leases"] == {}
+
+
+def test_lease_journal_restart_grace(tmp_path):
+    """Registry restart keeps epochs (zombie protection stays armed) but
+    resets lease timestamps — a restart must not mass-expire the fleet."""
+    j = str(tmp_path / "journal.jsonl")
+    clock = _TickClock(100.0)
+    reg = TelemetryRegistry(journal=j, clock=clock)
+    reg.put_lease("n0", 7, ttl_s=5.0)
+    reg.put_lease("n1", 2, ttl_s=5.0)
+    reg.drop_lease("n1")                      # decommissions stay dropped
+
+    clock2 = _TickClock(10_000.0)             # much later wall time
+    replayed = TelemetryRegistry(journal=j, clock=clock2)
+    leases = replayed.leases()
+    assert set(leases) == {"n0"}
+    assert leases["n0"]["epoch"] == 7         # epoch survives
+    assert replayed.stale_nodes() == []       # ts reset: one TTL of grace
+    # and the monotonic check still refuses the pre-restart zombie
+    assert replayed.put_lease("n0", 6) == (False, 7)
+
+
+def test_lease_journal_compaction_preserves_leases(tmp_path):
+    j = str(tmp_path / "journal.jsonl")
+    reg = TelemetryRegistry(journal=j, compact_every=10,
+                            clock=_TickClock())
+    for i in range(1, 25):                    # crosses compaction twice
+        reg.put_lease("n0", i)
+    replayed = TelemetryRegistry(journal=j, clock=_TickClock())
+    assert replayed.leases()["n0"]["epoch"] == 24
+
+
+def test_lease_age_gauge_in_exposition():
+    reg = TelemetryRegistry(clock=_TickClock())
+    reg.put_lease("n0", 1)
+    text = reg.render_metrics()
+    assert 'kubeshare_lease_age_seconds{node="n0"}' in text
+
+
+def test_heartbeater_restart_takeover(registry, client):
+    from kubeshare_tpu.telemetry import Heartbeater
+
+    hb = Heartbeater(client, "tpu-host-0", ttl_s=5.0)
+    assert hb.beat_once() and hb.beat_once()
+    first_epochs = client.leases()["leases"]["tpu-host-0"]["epoch"]
+    # a restarted agent reads the recorded epoch and supersedes it
+    hb2 = Heartbeater(client, "tpu-host-0", ttl_s=5.0)
+    assert hb2.beat_once()
+    assert client.leases()["leases"]["tpu-host-0"]["epoch"] > first_epochs
+    # ...after which the old incarnation's next beat is refused once,
+    # and it jumps past the winner (last publisher wins)
+    assert not hb.beat_once()
+    assert hb.beat_once()
+
+
+def test_heartbeat_suppression_injector(registry, client):
+    from kubeshare_tpu.resilience.faults import FaultSpec, Injector, install
+    from kubeshare_tpu.telemetry import Heartbeater
+
+    install(Injector(FaultSpec(suppress_heartbeats_node="tpu-host-0")))
+    try:
+        hb = Heartbeater(client, "tpu-host-0", ttl_s=5.0)
+        other = Heartbeater(client, "tpu-host-1", ttl_s=5.0)
+        assert not hb.beat_once()             # silenced, not an error
+        assert other.beat_once()              # selective by node
+        assert "tpu-host-0" not in client.leases()["leases"]
+    finally:
+        install(None)
