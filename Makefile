@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot image clean obs-check
 
 all: native
 
@@ -59,6 +59,14 @@ bench-recovery:
 bench-health:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_health.py \
 		--baseline bench_health.json --write bench_health.json
+
+# Autopilot micro-bench (doc/autopilot.md): seeded churn convergence
+# (fragmentation reduction, move/rollback counts, plan latency) and
+# elastic reclaim (lend ratio, revoke latency); refreshes
+# bench_autopilot.json.
+bench-autopilot:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_autopilot.py \
+		--baseline bench_autopilot.json --write bench_autopilot.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -1,0 +1,16 @@
+"""Autopilot plane: fragmentation-aware rebalancing + elastic quota
+reclamation (doc/autopilot.md).
+
+Layered on the four existing planes: reads capacity through the
+scheduler engine, executes through the dispatcher's apply_move and the
+resilience plane's migration path, lends idle shares through the
+isolation plane's token scheduler, and reports through the obs plane.
+"""
+
+from .controller import Autopilot
+from .elastic import ElasticQuota
+from .planner import Planner, fragmentation_score, fragmentation_view
+from .rebalancer import Rebalancer
+
+__all__ = ["Autopilot", "ElasticQuota", "Planner", "Rebalancer",
+           "fragmentation_score", "fragmentation_view"]

@@ -175,6 +175,37 @@ def check_scheduler(addr: str, timeout_s: float,
     return _result("scheduler", "ok", f"{addr}: {n} node(s) in the engine")
 
 
+def check_autopilot(addr: str, timeout_s: float,
+                    defaulted: bool = False) -> bool:
+    """Autopilot plane probe (doc/autopilot.md): ``/autopilot`` must
+    answer; a detached autopilot is a skip (the plane is opt-in via
+    ``--autopilot``), an attached one reports its fragmentation score."""
+    if not addr or addr == "none":
+        return _result("autopilot", "skip", "--scheduler none")
+    try:
+        state = json.loads(_get(f"http://{addr}/autopilot", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("autopilot", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("autopilot", "skip",
+                           "scheduler predates /autopilot")
+        return _result("autopilot", "fail", f"{addr}: {exc}")
+    if not state.get("attached"):
+        return _result("autopilot", "skip",
+                       "not attached (start the scheduler with "
+                       "--autopilot to enable)")
+    frag = state.get("fragmentation", 0.0)
+    return _result(
+        "autopilot", "ok",
+        f"{addr}: {'enabled' if state.get('enabled') else 'DISABLED'}, "
+        f"fragmentation {frag:.4f}, {state.get('cycles', 0)} cycle(s), "
+        f"{state.get('applied_total', 0)} applied / "
+        f"{state.get('rolled_back_total', 0)} rolled back")
+
+
 def check_leases(addr: str, timeout_s: float, node: str,
                  defaulted: bool = False) -> bool:
     """Three health-plane probes against one ``/leases`` read: endpoint
@@ -300,6 +331,7 @@ def main(argv=None) -> int:
     ok &= check_discovery(chip_ok, args.chip_timeout)
     ok &= check_registry(registry, 5.0, defaulted=reg_defaulted)
     ok &= check_scheduler(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_autopilot(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

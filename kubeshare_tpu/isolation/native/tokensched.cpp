@@ -131,6 +131,20 @@ int ts_add_client(void* h, const char* name, double request, double limit) {
   return 0;
 }
 
+// Adjust a client's effective share in place (elastic burst credit,
+// doc/autopilot.md): same validation as ts_add_client, takes hold at the
+// next ts_poll — vtime and the usage window are untouched, so a revoke is
+// symmetric and instant.
+int ts_set_effective(void* h, const char* name, double request, double limit) {
+  auto* s = static_cast<Scheduler*>(h);
+  if (request <= 0.0 || limit <= 0.0 || limit > 1.0 || request > limit) return -1;
+  Client* c = find(s, name);
+  if (!c) return -2;
+  c->request = request;
+  c->limit = limit;
+  return 0;
+}
+
 int ts_remove_client(void* h, const char* name) {
   auto* s = static_cast<Scheduler*>(h);
   if (!s->clients.count(name)) return -1;

@@ -158,6 +158,19 @@ class PyTokenCore:
             c.vtime += used_ms / c.request
         self._holder = None
 
+    def set_effective(self, name: str, request: float, limit: float) -> None:
+        """Adjust a client's effective share in place (elastic burst
+        credit, doc/autopilot.md): same validation as add_client, takes
+        hold at the next grant decision — usage history and vtime are
+        untouched, so revoking is symmetric and instant."""
+        if request <= 0 or limit <= 0 or limit > 1 or request > limit:
+            raise ValueError(f"bad request/limit: {request}/{limit}")
+        c = self._clients.get(name)
+        if c is None:
+            raise KeyError(name)
+        c.request = request
+        c.limit = limit
+
     def window_usage(self, name: str, now_ms: float) -> float:
         return self._clients[name].window_usage(now_ms, self.window_ms)
 
@@ -251,6 +264,23 @@ class NativeTokenCore:
         if self._lib.ts_release_token(self._handle(), name.encode(), used_ms, now_ms) != 0:
             raise ValueError(f"{name} does not hold the token")
 
+    def set_effective(self, name: str, request: float, limit: float) -> None:
+        try:
+            fn = self._lib.ts_set_effective
+        except AttributeError:
+            # a libtokensched.so built before the autopilot plane —
+            # surface it as unavailable, never silently drop the credit
+            raise RuntimeError(
+                "native tokensched predates ts_set_effective; "
+                "rebuild with `make native`") from None
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                       ctypes.c_double, ctypes.c_double]
+        rc = fn(self._handle(), name.encode(), request, limit)
+        if rc == -1:
+            raise ValueError(f"bad request/limit: {request}/{limit}")
+        if rc == -2:
+            raise KeyError(name)
+
     def window_usage(self, name: str, now_ms: float) -> float:
         u = self._lib.ts_window_usage(self._handle(), name.encode(), now_ms)
         if u < 0:
@@ -319,6 +349,15 @@ class TokenScheduler:
         self._clock = clock or _now_ms
         self.window_ms = window_ms
         self.chip = chip or "chip"           # metric label for this token
+        self._shares: dict[str, tuple[float, float]] = {}   # base
+        self._effective: dict[str, tuple[float, float]] = {}
+        #: demand hook (elastic quota, doc/autopilot.md): called as
+        #: ``on_demand(name)`` under the lock the moment a client asks
+        #: for the token, BEFORE the grant decision — a lender whose
+        #: demand returns gets its credit revoked within that same
+        #: token cycle. Exceptions are swallowed: quota policy must
+        #: never break the data path.
+        self.on_demand = None
 
     @property
     def core(self):
@@ -327,13 +366,59 @@ class TokenScheduler:
     def add_client(self, name: str, request: float, limit: float) -> None:
         with self._cond:
             self._core.add_client(name, request, limit)
+            self._shares[name] = (request, limit)
+            self._effective[name] = (request, limit)
 
     def remove_client(self, name: str) -> None:
         with self._cond:
             self._core.remove_client(name)
             self._grants.pop(name, None)
             self._held_since.pop(name, None)
+            self._shares.pop(name, None)
+            self._effective.pop(name, None)
             self._cond.notify_all()
+
+    def set_effective(self, name: str, request: float, limit: float) -> bool:
+        """Push an adjusted effective share into the core (burst credit
+        grant or revocation). Returns False when the native core predates
+        the call — the caller must treat the credit as never granted."""
+        with self._cond:
+            try:
+                self._core.set_effective(name, request, limit)
+            except RuntimeError:
+                return False
+            self._effective[name] = (request, limit)
+            self._cond.notify_all()   # a raised limit may unblock a waiter
+            return True
+
+    def shares(self) -> dict[str, tuple[float, float]]:
+        """Base (guaranteed) ``{name: (request, limit)}`` as registered —
+        never mutated by burst credit."""
+        with self._cond:
+            return dict(self._shares)
+
+    def effective(self, name: str) -> tuple[float, float]:
+        with self._cond:
+            return self._effective[name]
+
+    def waiting(self) -> list[str]:
+        """Names with at least one façade-level waiter queued right now."""
+        with self._cond:
+            return [n for n, q in self._waiting.items() if q]
+
+    def now_ms(self) -> float:
+        """This scheduler's clock (injectable in tests) — the timebase
+        window_usage is measured on."""
+        return self._clock()
+
+    def _note_demand(self, name: str) -> None:
+        # caller holds self._cond, right after request_token
+        if self.on_demand is None:
+            return
+        try:
+            self.on_demand(name)
+        except Exception:
+            log.exception("on_demand hook failed for %s", name)
 
     def acquire(self, name: str, timeout: float | None = None,
                 trace_id: str = "") -> float:
@@ -341,6 +426,7 @@ class TokenScheduler:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             self._core.request_token(name)
+            self._note_demand(name)
             t0 = time.monotonic()
             quota = self._wait_for_grant(name, deadline)
             self._note_grant(name, time.monotonic() - t0, trace_id)
@@ -363,6 +449,7 @@ class TokenScheduler:
             self._core.release_token(name, used_ms, self._clock())
             self._note_release(name)
             self._core.request_token(name)
+            self._note_demand(name)
             self._cond.notify_all()
             t0 = time.monotonic()
             quota = self._wait_for_grant(name, deadline)

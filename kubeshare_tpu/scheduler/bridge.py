@@ -212,6 +212,15 @@ class ServiceClient:
             raise RuntimeError(f"/health returned {code}")
         return body
 
+    def autopilot(self) -> dict:
+        """Autopilot snapshot (``GET /autopilot``, doc/autopilot.md);
+        ``{"attached": false}`` when the plane is off, RuntimeError when
+        the scheduler predates it."""
+        code, body = self._call("GET", "/autopilot")
+        if code != 200:
+            raise RuntimeError(f"/autopilot returned {code}")
+        return body
+
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
 

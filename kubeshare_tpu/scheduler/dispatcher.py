@@ -26,6 +26,7 @@ clock; :meth:`start` runs the same step on a background thread.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -33,9 +34,11 @@ from dataclasses import dataclass, field
 from .. import constants as C
 from ..obs import metrics as obs_metrics
 from ..obs.trace import get_tracer
+from ..topology.cell import reclaim_resource, reserve_resource
 from ..utils.logger import get_logger
 from .engine import Binding, SchedulerEngine, Unschedulable
 from .labels import PodRequest
+from .scoring import select_cells
 
 log = get_logger("dispatcher")
 
@@ -523,35 +526,161 @@ class Dispatcher:
     def plan_migration(self, key: str, exclude=()) -> dict | None:
         """Dry-run a destination for live-migrating a bound pod's proxy
         session off its node (drain/rebalance tooling): the same
-        filter→score→normalize pipeline as a scheduling cycle, minus the
-        reserve — nothing is booked, the plan is advisory. ``exclude``
-        adds nodes the mover already knows are unusable (e.g. the one
-        being drained, when the pod is not bound there). Returns
-        ``{"pod", "from", "node", "scores"}`` or None when no other node
-        passes filtering."""
+        filter→score→normalize pipeline as a scheduling cycle, with a
+        transient reservation per planned member so later members see
+        the capacity earlier ones would consume — every booking is
+        rolled back before returning, the plan stays advisory.
+        ``exclude`` adds nodes the mover already knows are unusable
+        (e.g. the one being drained, when the pod is not bound there).
+
+        Gang semantics: for a member of a bound gang the plan covers
+        EVERY bound member — planning one member alone would silently
+        split the gang — and is None unless all of them place
+        (doc/autopilot.md, safety rails). Whole-chip gangs steered by an
+        active placement plan refuse migration here (their members'
+        filter pins them to planned slots); the autopilot only ever
+        moves fractional pods, which never hold gang plans.
+
+        Returns ``{"pod", "from", "node", "scores", "moves"}`` or None.
+        ``pod``/``from``/``node``/``scores`` describe the queried pod
+        (the pre-gang-aware contract, kept for the health plane's
+        migrate_fn); ``moves`` lists ``{"pod", "from", "node"}`` for the
+        full move-set, in apply order."""
         with self._cond:
             pod = self.engine.pod_status.get(key)
             if pod is None:
                 return None
-            skip = set(exclude) | ({pod.node_name} if pod.node_name
-                                   else set())
-            candidates = []
-            for node in self.engine.nodes:
-                if node in skip:
-                    continue
-                fit, why = self.engine.filter(pod, node)
-                if fit:
-                    candidates.append(node)
-                else:
-                    log.debug("plan_migration: %s rejected %s: %s",
-                              node, key, why)
-            if not candidates:
-                return None
-            raw = {n: self.engine.score(pod, n) for n in candidates}
-            norm = self.engine.normalize_scores(raw)
-            best = max(sorted(candidates), key=lambda n: norm[n])
-            return {"pod": key, "from": pod.node_name, "node": best,
-                    "scores": dict(norm)}
+            if pod.group_name:
+                members = [m for m in self.engine._group_members(pod)
+                           if m.node_name]
+                if pod not in members:
+                    return None       # queried member itself is unbound
+                # queried pod first so "node"/"scores" describe it
+                members.sort(key=lambda m: (m.key != key, m.key))
+            else:
+                members = [pod]
+            booked: list[tuple] = []   # transient (cell, compute, mem)
+            moves: list[dict] = []
+            head: dict | None = None
+            try:
+                for m in members:
+                    placed = self._plan_member_locked(m, exclude, booked)
+                    if placed is None:
+                        return None    # all-or-nothing: no silent split
+                    moves.append({"pod": m.key, "from": m.node_name,
+                                  "node": placed["node"]})
+                    if m.key == key:
+                        head = placed
+            finally:
+                for cell, compute, memory in reversed(booked):
+                    reclaim_resource(cell, compute, memory)
+            return {"pod": key, "from": pod.node_name,
+                    "node": head["node"], "scores": head["scores"],
+                    "moves": moves}
+
+    def _plan_member_locked(self, pod: PodRequest, exclude,
+                            booked: list) -> dict | None:
+        """One member of a migration plan: filter→score→normalize, then
+        verify cell choice with select_cells and book it transiently (in
+        ``booked``, caller rolls back) so gang siblings planned after
+        this one cannot be promised the same capacity."""
+        skip = set(exclude) | ({pod.node_name} if pod.node_name else set())
+        candidates = []
+        for node in self.engine.nodes:
+            if node in skip:
+                continue
+            fit, why = self.engine.filter(pod, node)
+            if fit:
+                candidates.append(node)
+            else:
+                log.debug("plan_migration: %s rejected %s: %s",
+                          node, pod.key, why)
+        if not candidates:
+            return None
+        raw = {n: self.engine.score(pod, n) for n in candidates}
+        norm = self.engine.normalize_scores(raw)
+        for node in sorted(candidates, key=lambda n: (-norm[n], n)):
+            cells = select_cells(self.engine.free_list, node, pod,
+                                 self.engine.chip_priority,
+                                 self.engine._group_cells(pod),
+                                 self.engine.mesh_shape)
+            if not cells:
+                continue      # scored but un-selectable (raced capacity)
+            if pod.multi_chip:
+                for cell in cells:
+                    booked.append((cell, cell.available, cell.free_memory))
+                    reserve_resource(cell, cell.available, cell.free_memory)
+            else:
+                cell = cells[0]
+                memory = pod.memory or int(
+                    math.floor(pod.request * cell.full_memory))
+                booked.append((cell, pod.request, memory))
+                reserve_resource(cell, pod.request, memory)
+            return {"node": node, "scores": dict(norm)}
+        return None
+
+    def apply_move(self, key: str, node: str) -> Binding:
+        """Re-bind one bound pod onto *node* in place — the executor for
+        an accepted migration plan (autopilot rebalancer, doc/autopilot.md):
+        unreserve → reserve on the destination → re-publish the binding,
+        preserving the gang rank (= jax.distributed process_id) across
+        the move so a migrated member keeps its identity. On failure the
+        source booking is restored and the source stays authoritative —
+        mirroring migrate.py's flip-last contract; if even the source
+        re-reserve fails (capacity raced away mid-move) the pod is cold
+        requeued like a health eviction. Raises Unschedulable when the
+        move did not happen."""
+        with self._cond:
+            now = self._clock()
+            pod = self.engine.pod_status.get(key)
+            if pod is None or not pod.node_name:
+                raise Unschedulable(f"{key}: not a bound pod")
+            if node == pod.node_name:
+                raise Unschedulable(f"{key}: already on {node}")
+            source = pod.node_name
+            rank = pod.group_rank
+            self.engine.unreserve(pod)    # also resets group_rank
+            pod.group_rank = rank         # the member keeps its rank
+            try:
+                return self._rebind_locked(pod, node)
+            except Unschedulable as move_err:
+                pod.group_rank = rank
+                try:
+                    self._rebind_locked(pod, source)
+                except Unschedulable as back_err:
+                    # catastrophic: neither side holds capacity anymore —
+                    # fall back to the eviction path (cold requeue, no
+                    # backoff) so the pod is rebound somewhere
+                    log.error("move of %s (%s -> %s) failed AND the "
+                              "source re-reserve failed (%s); requeueing",
+                              key, source, node, back_err)
+                    pod.timestamp = now
+                    self._pending[key] = pod
+                    self._retry_at[key] = now
+                    self._last_reason[key] = (f"rebalance move failed "
+                                              f"({source} -> {node})")
+                    self._results.pop(key, None)
+                    self._withdraw(key)
+                    self._cond.notify_all()
+                raise Unschedulable(
+                    f"{key}: move {source} -> {node} failed "
+                    f"({move_err}); source restored") from move_err
+
+    def _rebind_locked(self, pod: PodRequest, node: str) -> Binding:
+        """Reserve + publish + resolve for an in-place move (caller holds
+        the lock and has already unreserved). Publish failure rolls the
+        fresh reservation back, same as a scheduling cycle."""
+        binding = self.engine.reserve(pod, node)
+        if self.registry is not None and pod.needs_tpu:
+            from ..telemetry.aggregator import publish_binding
+
+            try:
+                publish_binding(self.registry, pod, binding)
+            except Exception as e:
+                self.engine.unreserve(pod)
+                raise Unschedulable(f"binding publish failed: {e}")
+        self._resolve(pod.key, Outcome("bound", binding=binding))
+        return binding
 
     def evict_node(self, node: str, now: float | None = None, *,
                    reason: str = "node lost",

@@ -21,6 +21,10 @@ API (JSON):
 - ``GET  /state``     engine snapshot (nodes, leaves, pods)
 - ``GET  /health``    per-node liveness states + shed/evicted totals
   (doc/health.md; empty when the health plane is off)
+- ``GET  /autopilot`` fragmentation score + move/credit state
+  (doc/autopilot.md; ``{"attached": false}`` when the plane is off)
+- ``POST /autopilot/plan``   dry-run: emit a migration plan, touch nothing
+- ``POST /autopilot/apply``  plan + execute one cycle (409 when detached)
 - ``GET  /healthz``
 
 Overload shedding: with ``max_pending`` set, ``POST /schedule`` answers
@@ -70,6 +74,14 @@ class SchedulerService:
             self.dispatcher.attach_healthwatch(self.healthwatch)
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
+        self.autopilot = None
+
+    def attach_autopilot(self, autopilot) -> "SchedulerService":
+        """Wire an :class:`~..autopilot.Autopilot` built over
+        ``self.dispatcher`` (doc/autopilot.md); exposes it on
+        ``/autopilot``."""
+        self.autopilot = autopilot
+        return self
 
     # -- operations --------------------------------------------------------
 
@@ -125,6 +137,12 @@ class SchedulerService:
                 "pending": len(d._pending),
                 "max_pending": d.max_pending,
             }
+
+    def autopilot_state(self) -> dict:
+        """``GET /autopilot`` body; cheap when no autopilot is wired."""
+        if self.autopilot is None:
+            return {"attached": False, "enabled": False}
+        return self.autopilot.snapshot()
 
     def render_metrics(self) -> str:
         """Scheduler-side Prometheus exposition (the reference's only
@@ -219,6 +237,8 @@ class SchedulerService:
                     return self._reply(200, svc.state())
                 if self.path == "/health":
                     return self._reply(200, svc.health())
+                if self.path == "/autopilot":
+                    return self._reply(200, svc.autopilot_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -243,6 +263,17 @@ class SchedulerService:
                                    body.get("node", ""),
                                    body.get("uid", ""))
                         return self._reply(200, {"ok": True})
+                    if self.path == "/autopilot/plan":
+                        if svc.autopilot is None:
+                            return self._reply(
+                                409, {"error": "autopilot not attached"})
+                        return self._reply(200,
+                                           {"plan": svc.autopilot.plan()})
+                    if self.path == "/autopilot/apply":
+                        if svc.autopilot is None:
+                            return self._reply(
+                                409, {"error": "autopilot not attached"})
+                        return self._reply(200, svc.autopilot.cycle())
                 except (LabelError, Unschedulable) as e:
                     return self._reply(409, {"error": str(e)})
                 except Exception as e:
@@ -307,6 +338,15 @@ def main(argv=None) -> None:
                              "discovery when omitted); the file is watched "
                              "and the process exits on change for a clean "
                              "rebuild (config.go:122-136 parity)")
+    parser.add_argument("--autopilot", action="store_true",
+                        help="attach the autopilot plane: /autopilot "
+                             "snapshot + explicit plan/apply endpoints "
+                             "(doc/autopilot.md)")
+    parser.add_argument("--autopilot-budget", type=int, default=8,
+                        help="autopilot per-cycle migration budget")
+    parser.add_argument("--autopilot-journal", default="",
+                        help="JSONL move journal path (crash-safe batch "
+                             "recovery); empty = no journal")
     args = parser.parse_args(argv)
 
     config = load_config(args.config) if args.config else None
@@ -317,6 +357,15 @@ def main(argv=None) -> None:
         healthwatch=(HealthWatch(registry, ttl_s=args.lease_ttl)
                      if args.health else None),
         max_pending=args.max_pending or None)
+    if args.autopilot:
+        from ..autopilot import Autopilot, Planner, Rebalancer
+
+        planner = Planner(svc.dispatcher, budget=args.autopilot_budget)
+        svc.attach_autopilot(Autopilot(
+            svc.dispatcher, planner=planner,
+            rebalancer=Rebalancer(svc.dispatcher, planner=planner,
+                                  journal_path=(args.autopilot_journal
+                                                or None))))
     svc.serve(args.host, args.port)
     watcher = ConfigWatcher(args.config).start() if args.config else None
     print("READY", flush=True)

@@ -71,6 +71,23 @@ def synthesize_labels(job: TraceJob, rng: random.Random) -> dict:
             C.POD_TPU_LIMIT: str(job.chips)}
 
 
+def synthesize_churn(n: int, rng: random.Random) -> list[TraceJob]:
+    """Churn workload for autopilot convergence runs (doc/autopilot.md):
+    all-fractional arrivals with widely spread runtimes, so early
+    departures keep tearing partial holes into chips the packer filled —
+    exactly the placement decay the rebalancer exists to undo. Offsets
+    chain like :func:`synthesize_trace`'s."""
+    return [TraceJob(rng.choice([0.0, 1.0, 1.0, 2.0, 4.0]), 1,
+                     float(rng.randint(20, 500)))
+            for _ in range(n)]
+
+
+def churn_labels(job: TraceJob, rng: random.Random) -> dict:
+    """Fractional-only labels for the churn workload."""
+    request = rng.choice((0.1, 0.15, 0.2, 0.25, 0.3, 0.4, 0.5))
+    return {C.POD_TPU_REQUEST: str(request), C.POD_TPU_LIMIT: "1.0"}
+
+
 @dataclass
 class SimStats:
     submitted: int = 0
@@ -85,13 +102,22 @@ class SimStats:
     chip_seconds: float = 0.0
     makespan_s: float = 0.0
     per_node: dict = field(default_factory=dict)
+    # autopilot cycles run inside the event loop (doc/autopilot.md):
+    # per-cycle {"t", "before", "after", "moves", "rolled_back"} records
+    # for cycles that found work, plus the best single-cycle relative
+    # fragmentation reduction (the CI convergence gate)
+    autopilot_cycles: int = 0
+    autopilot_moves: int = 0
+    autopilot_rollbacks: int = 0
+    autopilot_best_reduction: float = 0.0
+    autopilot_log: list = field(default_factory=list)
 
     @property
     def mean_wait_s(self) -> float:
         return self.total_wait_s / self.placed if self.placed else 0.0
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "submitted": self.submitted, "placed": self.placed,
             "failed": self.failed, "retries": self.retries,
             "preemptions": self.preemptions, "restarts": self.restarts,
@@ -102,6 +128,15 @@ class SimStats:
             "makespan_s": round(self.makespan_s, 1),
             "per_node": self.per_node,
         }
+        if self.autopilot_cycles:
+            out["autopilot"] = {
+                "cycles": self.autopilot_cycles,
+                "moves": self.autopilot_moves,
+                "rollbacks": self.autopilot_rollbacks,
+                "best_reduction": round(self.autopilot_best_reduction, 4),
+                "log": self.autopilot_log,
+            }
+        return out
 
 
 class Simulator:
@@ -116,10 +151,16 @@ class Simulator:
 
     def __init__(self, engine: SchedulerEngine, seed: int = 0,
                  namespace: str = "sim", preempt: bool = False,
-                 label_fn=None, failures: list | None = None):
+                 label_fn=None, failures: list | None = None,
+                 autopilot=None, autopilot_every: float = 0.0):
         self.engine = engine
         self.rng = random.Random(seed)
         self.namespace = namespace
+        #: an :class:`~..autopilot.Autopilot` over a Dispatcher sharing
+        #: this engine; ``cycle()`` runs every ``autopilot_every``
+        #: virtual seconds while jobs are live (doc/autopilot.md)
+        self.autopilot = autopilot
+        self.autopilot_every = autopilot_every
         #: model the dispatcher's preemption: a blocked guarantee job
         #: displaces opportunistic filler (fewest-victim plan); victims
         #: restart from scratch via the pending queue
@@ -158,6 +199,10 @@ class Simulator:
             seq += 1
             heapq.heappush(events, (float(fail_at) + float(down_for), seq,
                                     "recover", node))
+            seq += 1
+        if self.autopilot is not None and self.autopilot_every > 0:
+            heapq.heappush(events, (self.autopilot_every, seq,
+                                    "autopilot", None))
             seq += 1
         pending: list[tuple[str, TraceJob, float]] = []
         now = 0.0
@@ -254,6 +299,28 @@ class Simulator:
             elif kind == "recover":
                 self.engine.set_node_health(payload, True)
                 retry_pending()
+            elif kind == "autopilot":
+                res = self.autopilot.cycle(now=now)
+                if res.get("moves") or res.get("applied"):
+                    before = res["fragmentation_before"]
+                    after = res["fragmentation_applied"]
+                    self.stats.autopilot_cycles += 1
+                    self.stats.autopilot_moves += len(res["applied"])
+                    self.stats.autopilot_rollbacks += len(
+                        res["rolled_back"]) + len(res["failed"])
+                    if before > 0:
+                        self.stats.autopilot_best_reduction = max(
+                            self.stats.autopilot_best_reduction,
+                            (before - after) / before)
+                    self.stats.autopilot_log.append({
+                        "t": round(now, 1),
+                        "before": before, "after": after,
+                        "moves": len(res["applied"]),
+                        "rolled_back": len(res["rolled_back"])})
+                if self._live or pending:
+                    heapq.heappush(events, (now + self.autopilot_every,
+                                            seq, "autopilot", None))
+                    seq += 1
             else:
                 if self._evicted.get(payload):
                     # the victim was preempted: its old completion event
@@ -310,13 +377,28 @@ def main(argv=None) -> None:
                              "priority 50 (the canonical synthesis is "
                              "all-opportunistic; >0 makes --preempt "
                              "meaningful)")
+    parser.add_argument("--churn", type=int, default=0, metavar="N",
+                        help="generate an N-job all-fractional churn "
+                             "trace (arrivals/departures tear partial "
+                             "holes into packed chips) — the autopilot "
+                             "convergence workload (doc/autopilot.md)")
+    parser.add_argument("--autopilot-every", type=float, default=0.0,
+                        metavar="S",
+                        help="run an autopilot plan+apply cycle every S "
+                             "virtual seconds (0 = autopilot off)")
+    parser.add_argument("--autopilot-budget", type=int, default=8,
+                        help="per-cycle migration budget")
     args = parser.parse_args(argv)
 
-    if bool(args.synthetic) == bool(args.trace):
-        parser.error("exactly one of --trace / --synthetic is required")
+    if sum(map(bool, (args.synthetic, args.trace, args.churn))) != 1:
+        parser.error("exactly one of --trace / --synthetic / --churn "
+                     "is required")
     if args.synthetic:
         import random
         jobs = synthesize_trace(args.synthetic, random.Random(args.seed))
+    elif args.churn:
+        import random
+        jobs = synthesize_churn(args.churn, random.Random(args.seed))
     else:
         with open(args.trace) as f:
             jobs = parse_trace(f.read())
@@ -327,9 +409,13 @@ def main(argv=None) -> None:
     for host, chips in chips_by_host.items():
         engine.add_node(host, chips)
     label_fn = None
+    if args.churn:
+        label_fn = churn_labels
     if args.guarantee_frac > 0:
-        def label_fn(job, rng, _f=args.guarantee_frac):
-            labels = synthesize_labels(job, rng)
+        base_fn = label_fn or synthesize_labels
+
+        def label_fn(job, rng, _f=args.guarantee_frac, _base=base_fn):
+            labels = _base(job, rng)
             if rng.random() < _f:
                 labels[C.POD_PRIORITY] = "50"
             return labels
@@ -341,8 +427,21 @@ def main(argv=None) -> None:
             failures.append((float(at), node, float(down)))
         except ValueError:
             parser.error(f"--fail wants NODE@T:DOWN, got {spec!r}")
+    autopilot = None
+    if args.autopilot_every > 0:
+        from ..autopilot import Autopilot, Planner, Rebalancer
+        from ..scheduler.dispatcher import Dispatcher
+
+        dispatcher = Dispatcher(engine)
+        planner = Planner(dispatcher, budget=args.autopilot_budget,
+                          cooldown_s=args.autopilot_every)
+        autopilot = Autopilot(dispatcher, planner=planner,
+                              rebalancer=Rebalancer(dispatcher,
+                                                    planner=planner))
     stats = Simulator(engine, seed=args.seed, preempt=args.preempt,
-                      label_fn=label_fn, failures=failures).run(jobs)
+                      label_fn=label_fn, failures=failures,
+                      autopilot=autopilot,
+                      autopilot_every=args.autopilot_every).run(jobs)
     print(json.dumps(stats.to_json()))
 
 

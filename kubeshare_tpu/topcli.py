@@ -12,7 +12,7 @@ Usage::
     python -m kubeshare_tpu.topcli [--registry HOST:PORT] [--node N]
                                    [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json] [--latency]
-                                   [--health]
+                                   [--health] [--autopilot]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
 ``--latency`` switches from the fleet table to the self-observability
@@ -24,6 +24,10 @@ utilization — scraped from the scheduler's ``/metrics`` when
 lease age and health state (+ time since the last transition), joined
 from the registry's ``/leases`` and — when ``--scheduler`` is given —
 the scheduler's ``/health`` (state machine, shed/evicted totals).
+``--autopilot`` renders the placement-optimization plane
+(``doc/autopilot.md``): cluster fragmentation score, pending/applied
+moves and per-chip burst credits from the scheduler's ``/autopilot``,
+joined with the registry's capacity and lease views.
 Exit 0 on a healthy read, 2 when the registry is unreachable.
 """
 
@@ -172,6 +176,102 @@ def render_health(snap: dict) -> str:
             f"pending {pend}"
             + (", quarantined: " + ", ".join(snap["quarantined"])
                if snap.get("quarantined") else ""))
+    return "\n".join(lines)
+
+
+def autopilot_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Autopilot join (doc/autopilot.md): the scheduler's ``/autopilot``
+    state (fragmentation, pending/applied moves, burst credits) over the
+    registry's per-chip capacity + lease view, so each chip row shows
+    its booked fraction, resident pods, lease age, and active credit."""
+    state: dict = {}
+    if scheduler is not None:
+        try:
+            state = scheduler.autopilot()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "autopilot state unavailable, showing capacity only",
+                  file=sys.stderr)
+    capacity = client.capacity()
+    pods = client.pods()
+    try:
+        raw = client.leases()
+        leases = raw.get("leases", raw) if isinstance(raw, dict) else {}
+    except Exception:
+        leases = {}
+    by_chip: dict[str, list] = {}
+    for key, rec in sorted(pods.items()):
+        for chip in filter(None, rec.get("chip_id", "").split(",")):
+            by_chip.setdefault(chip, []).append((key, rec))
+    credits = (state.get("burst_credits") or {}).get("chips", {})
+    chips = []
+    for node, entry in sorted(capacity.items()):
+        lease = leases.get(node, {})
+        for labels in entry.get("chips", []):
+            cid = labels.get("chip_id", "?")
+            residents = by_chip.get(cid, [])
+            booked = sum(min(float(r.get("request", 0) or 0), 1.0)
+                         for _, r in residents)
+            chip_credits = credits.get(cid, {})
+            chips.append({
+                "chip_id": cid,
+                "node": node,
+                "lease_age_s": round(float(lease.get("age_s", 0.0)), 1),
+                "booked": round(booked, 3),
+                "free": round(max(0.0, 1.0 - booked), 3),
+                "pods": [k for k, _ in residents],
+                "credits": {name: cr.get("amount", 0.0)
+                            for name, cr in chip_credits.items()},
+            })
+    return {"autopilot": state or {"attached": False, "enabled": False},
+            "chips": chips,
+            "pending_moves": state.get("pending_moves", []),
+            }
+
+
+def render_autopilot(snap: dict) -> str:
+    ap = snap["autopilot"]
+    lines = ["AUTOPILOT (placement optimization, doc/autopilot.md)"]
+    if not ap.get("attached"):
+        lines.append("  not attached — start the scheduler with "
+                     "--autopilot (or attach_autopilot)")
+    else:
+        lines.append(
+            f"  {'enabled' if ap.get('enabled') else 'DISABLED'}  "
+            f"fragmentation {ap.get('fragmentation', 0.0):.4f}  "
+            f"largest placeable gang {ap.get('largest_placeable_gang', 0)}  "
+            f"cycles {ap.get('cycles', 0)}")
+        lines.append(
+            f"  moves: {ap.get('applied_total', 0)} applied, "
+            f"{ap.get('rolled_back_total', 0)} rolled back, "
+            f"{len(snap.get('pending_moves', []))} pending")
+        bc = ap.get("burst_credits") or {}
+        if bc:
+            lines.append(
+                f"  elastic: {bc.get('reclaimed_ms', 0.0):.0f} device-ms "
+                f"reclaimed, {bc.get('revocations', 0)} revocations")
+        if ap.get("recovered"):
+            rec = ap["recovered"]
+            lines.append(
+                f"  RECOVERED batch {rec.get('batch')}: "
+                f"{len(rec.get('completed', []))} completed, "
+                f"{len(rec.get('abandoned', []))} abandoned "
+                "(source authoritative)")
+    for mv in snap.get("pending_moves", []):
+        lines.append(f"  plan: {mv.get('pod')}  {mv.get('from')} -> "
+                     f"{mv.get('node')}"
+                     + (f"  [gang {mv['group']}]" if mv.get("group")
+                        else ""))
+    if snap["chips"]:
+        lines.append(f"  {'chip':<28} {'node':<18} {'lease':>7} "
+                     f"{'booked':>7} {'free':>6}  credits")
+        for c in snap["chips"]:
+            credit = ", ".join(f"{name}+{amt:.2f}"
+                               for name, amt in sorted(c["credits"].items()))
+            lines.append(
+                f"  {c['chip_id']:<28} {c['node']:<18} "
+                f"{c['lease_age_s']:>6.1f}s {c['booked']:>7} "
+                f"{c['free']:>6}  {credit or '-'}")
     return "\n".join(lines)
 
 
@@ -326,6 +426,11 @@ def main(argv=None) -> int:
                         help="per-node lease age + health state (and "
                              "shed/evicted totals with --scheduler) "
                              "instead of the fleet table")
+    parser.add_argument("--autopilot", action="store_true",
+                        help="fragmentation score, pending/applied moves "
+                             "and per-chip burst credits (needs "
+                             "--scheduler for autopilot state) instead "
+                             "of the fleet table")
     args = parser.parse_args(argv)
     host, _, port = args.registry.rpartition(":")
     client = RegistryClient(host or "127.0.0.1", int(port))
@@ -352,7 +457,11 @@ def main(argv=None) -> int:
     try:
         while True:
             try:
-                if args.health:
+                if args.autopilot:
+                    aps = autopilot_snapshot(client, scheduler)
+                    out = (json.dumps(aps) if args.json
+                           else render_autopilot(aps))
+                elif args.health:
                     hs = health_snapshot(client, scheduler)
                     out = json.dumps(hs) if args.json else render_health(hs)
                 elif args.latency:

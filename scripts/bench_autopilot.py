@@ -1,0 +1,237 @@
+"""Autopilot micro-benchmark: what closed-loop placement optimization
+buys and costs (doc/autopilot.md).
+
+The autopilot promises two measurable things. First, **convergence**:
+on a churned fleet (arrivals/departures tearing partial holes into
+packed chips) one plan+apply cycle reduces the cluster fragmentation
+score, moves land within the per-cycle budget, and nothing rolls back.
+Second, **elastic reclaim**: a measurably idle client's guaranteed
+headroom is lent to a starved co-tenant as revocable burst credit, and
+the credit is revoked within one token cycle of the lender's demand
+returning. This bench puts numbers on both:
+
+- ``fragmentation_reduction_pct``: best single-cycle relative reduction
+  of the fragmentation score over the seeded churn run (virtual time,
+  the same ``sim --churn`` scenario CI gates on).
+- ``autopilot_moves`` / ``autopilot_rollbacks``: migrations applied and
+  rolled back across the run — the acceptance bar is rollbacks == 0.
+- ``plan_latency_ms_p50/p99``: wall-clock cost of one ``Planner.plan``
+  over the live engine (what the autopilot adds to its cadence).
+- ``elastic_lend_ratio``: fraction of the idle lender's guaranteed
+  request actually lent (the bar is >= 0.5 of measurable headroom).
+- ``revoke_to_grant_us_p50``: wall time from the lender's re-demand
+  (``acquire``) to its granted token, with the revocation running
+  inside that same call — demand-triggered, not poll-triggered.
+
+Run: ``python scripts/bench_autopilot.py`` → one JSON object (committed
+as ``bench_autopilot.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers (``make bench-autopilot`` does
+both against ``bench_autopilot.json``). ``--check`` exits non-zero
+unless the acceptance bars hold (the CI convergence smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line (the rest of the JSON is descriptive)
+_METRICS = ("fragmentation_reduction_pct", "autopilot_moves",
+            "plan_latency_ms_p50", "plan_latency_ms_p99",
+            "elastic_lend_ratio", "revoke_to_grant_us_p50")
+#: metrics where larger is better (the rest are latencies)
+_HIGHER_IS_BETTER = ("fragmentation_reduction_pct", "autopilot_moves",
+                     "elastic_lend_ratio")
+
+#: the seeded convergence scenario — keep in lockstep with the CI smoke
+#: step (.github/workflows/ci.yml) and tests/test_autopilot.py
+CHURN_JOBS, TOPOLOGY, SEED, EVERY_S, BUDGET = 80, "4:2x2@TPU-v4", 7, 60.0, 8
+
+ELASTIC_RUNS = 50
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+
+def _converge() -> tuple[dict, list[float]]:
+    """The seeded churn run, autopilot in the loop; returns the sim's
+    autopilot stats + wall-clock plan latencies (ms)."""
+    from kubeshare_tpu.autopilot import Autopilot, Planner, Rebalancer
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+    from kubeshare_tpu.sim.simulator import (Simulator, churn_labels,
+                                             synthesize_churn)
+    from kubeshare_tpu.topology.discovery import parse_fake_spec
+
+    engine = SchedulerEngine()
+    by_host: dict = {}
+    for chip in parse_fake_spec(TOPOLOGY).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        engine.add_node(host, chips)
+    dispatcher = Dispatcher(engine)
+    planner = Planner(dispatcher, budget=BUDGET, cooldown_s=EVERY_S)
+    autopilot = Autopilot(dispatcher, planner=planner,
+                          rebalancer=Rebalancer(dispatcher, planner=planner))
+
+    latencies: list[float] = []
+    inner_plan = planner.plan
+
+    def timed_plan(now=None):
+        t0 = time.perf_counter()
+        out = inner_plan(now=now)
+        latencies.append((time.perf_counter() - t0) * 1e3)
+        return out
+
+    planner.plan = timed_plan
+    jobs = synthesize_churn(CHURN_JOBS, random.Random(SEED))
+    stats = Simulator(engine, seed=SEED, label_fn=churn_labels,
+                      autopilot=autopilot, autopilot_every=EVERY_S).run(jobs)
+    return stats.to_json(), latencies
+
+
+def _elastic_arc() -> tuple[float, float]:
+    """One lend→revoke arc on a fake ms clock: idle lender A (0.6/1.0),
+    hot borrower B (0.2/0.3) at ~0.26 of a 10 s window. Returns
+    (lend_ratio_of_lender_request, revoke_to_grant_wall_us)."""
+    from kubeshare_tpu.autopilot import ElasticQuota
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    clock = _Clock()
+    sched = TokenScheduler(window_ms=10_000.0, clock=clock, chip="bench")
+    sched.add_client("A", 0.6, 1.0)
+    sched.add_client("B", 0.2, 0.3)
+    elastic = ElasticQuota({"bench": sched})
+
+    # B runs hot against its 0.3 limit: 4 x 650 ms bursts = 0.26 window
+    for _ in range(4):
+        sched.acquire("B", timeout=5.0)
+        clock.t += 650.0
+        sched.release("B", used_ms=650.0)
+        clock.t += 50.0
+    elastic.step()
+    eff_req, eff_limit = sched.effective("B")
+    lend_ratio = (eff_limit - 0.3) / 0.6     # credit / lender's request
+
+    # the lender's demand returns: the acquire itself must revoke first
+    t0 = time.perf_counter()
+    sched.acquire("A", timeout=5.0)
+    revoke_us = (time.perf_counter() - t0) * 1e6
+    assert sched.effective("B") == (0.2, 0.3), \
+        "credit not revoked by the lender's own demand"
+    sched.release("A", used_ms=1.0)
+    sched.close()
+    return lend_ratio, revoke_us
+
+
+def run_bench() -> dict:
+    out: dict = {"bench": "autopilot plane: churn convergence (virtual "
+                          "clock) + plan cost / elastic reclaim (wall)",
+                 "churn_jobs": CHURN_JOBS, "topology": TOPOLOGY,
+                 "seed": SEED, "autopilot_every_s": EVERY_S,
+                 "budget": BUDGET}
+
+    stats, latencies = _converge()
+    ap = stats.get("autopilot", {})
+    out["autopilot_cycles"] = ap.get("cycles", 0)
+    out["autopilot_moves"] = ap.get("moves", 0)
+    out["autopilot_rollbacks"] = ap.get("rollbacks", 0)
+    out["fragmentation_reduction_pct"] = round(
+        100.0 * ap.get("best_reduction", 0.0), 1)
+    out["plan_latency_ms_p50"] = round(statistics.median(latencies), 2)
+    out["plan_latency_ms_p99"] = round(_percentile(latencies, 0.99), 2)
+
+    ratios, revokes = [], []
+    for _ in range(ELASTIC_RUNS):
+        ratio, us = _elastic_arc()
+        ratios.append(ratio)
+        revokes.append(us)
+    out["elastic_lend_ratio"] = round(statistics.median(ratios), 3)
+    out["revoke_to_grant_us_p50"] = round(statistics.median(revokes), 1)
+    out["elastic_runs"] = ELASTIC_RUNS
+    return out
+
+
+def check(out: dict) -> int:
+    """The CI convergence smoke (doc/autopilot.md acceptance bars)."""
+    bars = (
+        ("fragmentation_reduction_pct", out["fragmentation_reduction_pct"],
+         ">= 30", out["fragmentation_reduction_pct"] >= 30.0),
+        ("autopilot_rollbacks", out["autopilot_rollbacks"],
+         "== 0", out["autopilot_rollbacks"] == 0),
+        ("autopilot_moves", out["autopilot_moves"],
+         f"<= budget x cycles ({BUDGET * max(1, out['autopilot_cycles'])})",
+         out["autopilot_moves"] <= BUDGET * max(1, out["autopilot_cycles"])),
+        ("elastic_lend_ratio", out["elastic_lend_ratio"],
+         ">= 0.5", out["elastic_lend_ratio"] >= 0.5),
+    )
+    failed = 0
+    for name, value, bar, ok in bars:
+        print(f"# {'ok' if ok else 'FAIL'}: {name} = {value} (want {bar})",
+              file=sys.stderr)
+        failed += 0 if ok else 1
+    return 1 if failed else 0
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:30s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:30s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_autopilot")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the convergence/reclaim "
+                             "acceptance bars hold (the CI smoke)")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
