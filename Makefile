@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo image clean obs-check
 
 all: native
 
@@ -30,12 +30,23 @@ test-all:
 test-slow:
 	$(PY) -m pytest tests/ -x -q -m slow
 
-# Observability plane gate: exposition-format lint + trace-propagation
-# tests, then the self-validating 3-pod smoke (doc/observability.md) —
-# fails on any malformed exposition or unstitched trace.
+# Observability plane gate: exposition-format lint (incl. exemplar
+# syntax round-trip), trace-propagation + SLO/burn-rate tests, the
+# self-validating 3-pod smoke, then a flight-recorder smoke — a sim
+# replay with an injected slow tenant must dump a parseable JSONL
+# black box (doc/observability.md).
 obs-check:
-	$(PY) -m pytest tests/test_obs.py tests/test_trace_propagation.py -x -q
+	$(PY) -m pytest tests/test_obs.py tests/test_trace_propagation.py \
+		tests/test_slo.py -x -q
 	$(PY) scripts/trace_demo.py
+	JAX_PLATFORMS=cpu $(PY) -m kubeshare_tpu.sim.simulator --synthetic 300 \
+		--slo 'queue-wait-p99<=500ms,availability>=99' \
+		--slow-tenant 'tenant-1@100:5' \
+		--flight-dump /tmp/kubeshare-flight-smoke.jsonl > /dev/null
+	$(PY) -c "from kubeshare_tpu.obs.flight import parse_dump_jsonl; \
+		d = parse_dump_jsonl(open('/tmp/kubeshare-flight-smoke.jsonl').read()); \
+		assert d['entries'], 'empty flight dump'; \
+		print('flight dump ok: %d entries' % len(d['entries']))"
 
 bench:
 	$(PY) bench.py
@@ -67,6 +78,13 @@ bench-health:
 bench-autopilot:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_autopilot.py \
 		--baseline bench_autopilot.json --write bench_autopilot.json
+
+# SLO-plane micro-bench (doc/observability.md): evaluator cost per
+# observation, exemplar surcharge, and burn-to-alert detection latency
+# in deterministic virtual time; refreshes bench_slo.json.
+bench-slo:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_slo.py \
+		--baseline bench_slo.json --write bench_slo.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

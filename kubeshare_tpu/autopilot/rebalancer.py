@@ -30,6 +30,7 @@ import re
 import time
 
 from ..obs import metrics as obs_metrics
+from ..obs.flight import default_recorder
 from ..obs.trace import get_tracer
 from ..utils.logger import get_logger
 
@@ -228,4 +229,12 @@ class Rebalancer:
         self._journal({"event": "batch_end", "batch": batch,
                        "applied": len(result["applied"]),
                        "rolled_back": len(result["rolled_back"])})
+        if result["failed"] or result["rolled_back"]:
+            # a rollback means live pods were yanked back mid-flight —
+            # snapshot the black box while the run-up is still in the
+            # ring (doc/observability.md, flight recorder)
+            default_recorder().trigger(
+                "autopilot-rollback", batch=batch,
+                failed=len(result["failed"]),
+                rolled_back=len(result["rolled_back"]))
         return result

@@ -34,6 +34,16 @@ POD_TPU_MODEL = DOMAIN + "tpu_model"
 # instead of retrying forever. 0/absent = no deadline.
 POD_DEADLINE = DOMAIN + "deadline"
 
+# Per-tenant service-level objectives (doc/observability.md, SLO plane):
+# comma-separated objectives, e.g. "grant-wait-p99<=50ms,availability>=99.9".
+# Parsed by obs/slo.py; declared per namespace at submit time.
+POD_SLO = DOMAIN + "slo"
+
+# Workload class for SLO attribution and (ROADMAP item 1) priority
+# isolation: "latency" | "best-effort". Absent = best-effort.
+POD_CLASS = DOMAIN + "class"
+TPU_CLASSES = ("latency", "best-effort")
+
 # --- scheduler-written annotations (constants.go:25-27) ---------------------
 POD_TPU_CHIP_ID = DOMAIN + "tpu_chip_id"     # ≙ sharedgpu/gpu_uuid
 POD_CELL_ID = DOMAIN + "cell_id"

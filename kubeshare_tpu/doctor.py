@@ -206,6 +206,56 @@ def check_autopilot(addr: str, timeout_s: float,
         f"{state.get('rolled_back_total', 0)} rolled back")
 
 
+def check_slo(addr: str, timeout_s: float,
+              defaulted: bool = False) -> bool:
+    """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
+    report no firing burn-rate alerts; ``/flightrecorder`` must answer
+    with a live ring (capacity > 0) — the black box is always on, so an
+    empty state is a wiring regression, not a skip."""
+    if not addr or addr == "none":
+        _result("slo", "skip", "--scheduler none")
+        return _result("flightrecorder", "skip", "--scheduler none")
+    try:
+        state = json.loads(_get(f"http://{addr}/slo", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            _result("slo", "skip",
+                    f"{addr} refused (no cluster on this host)")
+            return _result("flightrecorder", "skip", "no scheduler")
+        if "404" in str(exc):
+            _result("slo", "skip", "scheduler predates /slo")
+            return _result("flightrecorder", "skip",
+                           "scheduler predates /flightrecorder")
+        _result("flightrecorder", "skip", "/slo unreachable")
+        return _result("slo", "fail", f"{addr}: {exc}")
+    tenants = state.get("tenants", {})
+    firing = [(t, o["objective"]) for t, objs in tenants.items()
+              for o in objs if o.get("firing")]
+    if firing:
+        ok = _result("slo", "fail",
+                     f"{len(firing)} objective(s) FIRING: " +
+                     ", ".join(f"{t}:{o}" for t, o in firing[:3]))
+    else:
+        n_obj = sum(len(objs) for objs in tenants.values())
+        ok = _result("slo", "ok",
+                     f"{addr}: {len(tenants)} tenant(s), {n_obj} "
+                     "objective(s), none firing")
+    try:
+        rec = json.loads(_get(f"http://{addr}/flightrecorder", timeout_s))
+    except Exception as exc:
+        return _result("flightrecorder", "fail", f"{addr}: {exc}") and ok
+    if not rec.get("capacity"):
+        return _result("flightrecorder", "fail",
+                       "recorder reports zero capacity — black box "
+                       "disabled?") and ok
+    return _result(
+        "flightrecorder", "ok",
+        f"ring {rec.get('ring_len', 0)}/{rec.get('capacity')} "
+        f"entries, {len(rec.get('dumps', []))} retained dump(s), "
+        f"{rec.get('dropped', 0)} dropped") and ok
+
+
 def check_leases(addr: str, timeout_s: float, node: str,
                  defaulted: bool = False) -> bool:
     """Three health-plane probes against one ``/leases`` read: endpoint
@@ -332,6 +382,7 @@ def main(argv=None) -> int:
     ok &= check_registry(registry, 5.0, defaulted=reg_defaulted)
     ok &= check_scheduler(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_autopilot(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_slo(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

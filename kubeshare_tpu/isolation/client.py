@@ -254,7 +254,8 @@ class ProxyClient:
     def __init__(self, host: str, port: int, name: str, request: float,
                  limit: float, memory: int = 0, timeout: float | None = None,
                  chunk_bytes: int = 64 << 20, trace_id: str = "",
-                 reconnect="auto", fault_tag: str = ""):
+                 reconnect="auto", fault_tag: str = "",
+                 tpu_class: str = "best-effort"):
         self.name = name
         #: transfer slab size for put/get; arrays whose serialized form
         #: exceeds it stream in slices, so checkpoint-sized buffers cross a
@@ -268,6 +269,10 @@ class ProxyClient:
             # it from the reply, leaving this client in lockstep mode
             # with no resilience — exactly the seed behavior
             "features": list(protocol.FEATURES)}
+        if tpu_class != "best-effort":
+            # per-tenant SLO attribution (sharedtpu/class); sent only when
+            # non-default so the wire to an old proxy stays unchanged
+            register["class"] = tpu_class
         if reconnect is None:
             # legacy transport: failures surface immediately, no replay —
             # and no resume token either, so a dropped connection frees the

@@ -36,6 +36,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs.flight import default_recorder as flight_default_recorder
 from ..resilience import faults as _faults
 from ..resilience.journal import SessionJournal
 from ..utils.logger import get_logger
@@ -176,6 +177,9 @@ class _Session:
     #: trace ID propagated by the client at register (protocol TRACE_KEY);
     #: handed to the token scheduler so grant-waits join the pod's timeline
     trace_id: str = ""
+    #: workload class (sharedtpu/class) propagated at register — tags the
+    #: token scheduler's per-tenant grant-wait series
+    tpu_class: str = "best-effort"
     # -- resilience state (resumable sessions only) ---------------------
     #: features negotiated at register; frozen for the session's lifetime
     features: frozenset = frozenset()
@@ -354,12 +358,15 @@ class ChipProxy:
     # -- session management --------------------------------------------------
 
     def _register(self, name: str, request: float, limit: float,
-                  memory: int) -> _Session:
+                  memory: int,
+                  tpu_class: str = "best-effort") -> _Session:
         with self._slock:
             if name in self._sessions:
                 raise ValueError(f"duplicate client {name}")
-            self.scheduler.add_client(name, request, limit)
+            self.scheduler.add_client(name, request, limit,
+                                      tpu_class=tpu_class)
             sess = _Session(name, request, limit, memory)
+            sess.tpu_class = tpu_class
             self._sessions[name] = sess
             return sess
 
@@ -426,6 +433,10 @@ class ChipProxy:
         sess.detach_ev.set()
         _DETACHES.inc()
         _DETACHED.inc()
+        flight_default_recorder().note("proxy", "session-detached",
+                                       client=sess.name,
+                                       trace_id=sess.trace_id,
+                                       hbm_parked=sess.hbm_used)
         self._journal_checkpoint(sess)
         log.info("client %s detached (%d bytes HBM parked, %d staged "
                  "uploads aborted)", sess.name, sess.hbm_used,
@@ -479,6 +490,7 @@ class ChipProxy:
             "limit": sess.limit,
             "memory": sess.memory_cap,
             "features": sorted(sess.features),
+            "class": sess.tpu_class,
             "trace_id": sess.trace_id,
             "next_id": sess.next_id,
             "last_rid": sess.last_rid,
@@ -527,10 +539,12 @@ class ChipProxy:
             if name in self._sessions:
                 return
         self.scheduler.add_client(name, float(m["request"]),
-                                  float(m["limit"]))
+                                  float(m["limit"]),
+                                  tpu_class=m.get("class", "best-effort"))
         sess = _Session(name, float(m["request"]), float(m["limit"]),
                         int(m.get("memory", 0)))
         sess.features = frozenset(m.get("features", ()))
+        sess.tpu_class = m.get("class", "best-effort")
         sess.resume_token = token
         sess.trace_id = str(m.get("trace_id", ""))
         sess.next_id = int(m.get("next_id", 0))
@@ -641,6 +655,14 @@ class ChipProxy:
             now = _now_ms()
             with self._slock:
                 sessions = list(self._sessions.values())
+            # black-box cadence: proxy population + traffic counters so a
+            # dump shows the proxy's recent shape (rate-limited inside)
+            flight_default_recorder().sample_deltas("proxy", {
+                "sessions": float(len(sessions)),
+                "detached": _DETACHED.value(),
+                "resumes_total": _RESUMES.value(),
+                "detaches_total": _DETACHES.value(),
+            })
             for sess in sessions:
                 with sess.lock:
                     idle = (sess.holding and not sess.busy
@@ -787,7 +809,8 @@ class ChipProxy:
         name = req["name"]
         sess = self._register(name, float(req["request"]),
                               float(req["limit"]),
-                              int(req.get("memory", 0)))
+                              int(req.get("memory", 0)),
+                              tpu_class=req.get("class", "best-effort"))
         sess.trace_id = state.get("trace_id", "")
         sess.disconnect = state.get("_disconnect")
         state["name"] = name
@@ -846,6 +869,10 @@ class ChipProxy:
         state["name"] = sess.name
         _RESUMES.inc()
         _DETACHED.inc(amount=-1.0)
+        flight_default_recorder().note("proxy", "session-resumed",
+                                       client=sess.name,
+                                       trace_id=sess.trace_id,
+                                       last_rid=sess.last_rid)
         log.info("session %s resumed (last_rid=%d)", sess.name,
                  sess.last_rid)
         return {"ok": True, "platforms": [self.platform],
@@ -880,10 +907,13 @@ class ChipProxy:
                 if token in self._by_token:
                     raise ValueError("resume token already present")
             self.scheduler.add_client(name, float(m["request"]),
-                                      float(m["limit"]))
+                                      float(m["limit"]),
+                                      tpu_class=m.get("class",
+                                                      "best-effort"))
             sess = _Session(name, float(m["request"]), float(m["limit"]),
                             int(m.get("memory", 0)))
             sess.features = frozenset(m.get("features", ()))
+            sess.tpu_class = m.get("class", "best-effort")
             sess.resume_token = token
             sess.trace_id = str(m.get("trace_id", ""))
             sess.next_id = int(m.get("next_id", 0))

@@ -25,6 +25,8 @@ from dataclasses import dataclass, field
 
 from ..constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs.flight import default_recorder as flight_default_recorder
 from ..obs.trace import get_tracer
 from ..utils.logger import get_logger
 from . import protocol
@@ -38,7 +40,8 @@ _OBS = obs_metrics.default_registry()
 _GRANT_WAIT = _OBS.histogram(
     "kubeshare_token_grant_wait_seconds",
     "Time a client blocked between requesting the chip token and the "
-    "grant.", labels=("chip",))
+    "grant, by tenant namespace and workload class.",
+    labels=("chip", "namespace", "tpu_class"))
 _HOLD = _OBS.histogram(
     "kubeshare_token_hold_seconds",
     "Wall time a client held the chip token before releasing it.",
@@ -351,6 +354,9 @@ class TokenScheduler:
         self.chip = chip or "chip"           # metric label for this token
         self._shares: dict[str, tuple[float, float]] = {}   # base
         self._effective: dict[str, tuple[float, float]] = {}
+        #: workload class per client (sharedtpu/class) — the grant-wait
+        #: histogram's per-tenant attribution (ROADMAP item 1 surface)
+        self._classes: dict[str, str] = {}
         #: demand hook (elastic quota, doc/autopilot.md): called as
         #: ``on_demand(name)`` under the lock the moment a client asks
         #: for the token, BEFORE the grant decision — a lender whose
@@ -363,11 +369,13 @@ class TokenScheduler:
     def core(self):
         return self._core
 
-    def add_client(self, name: str, request: float, limit: float) -> None:
+    def add_client(self, name: str, request: float, limit: float,
+                   tpu_class: str = "best-effort") -> None:
         with self._cond:
             self._core.add_client(name, request, limit)
             self._shares[name] = (request, limit)
             self._effective[name] = (request, limit)
+            self._classes[name] = tpu_class or "best-effort"
 
     def remove_client(self, name: str) -> None:
         with self._cond:
@@ -376,6 +384,7 @@ class TokenScheduler:
             self._held_since.pop(name, None)
             self._shares.pop(name, None)
             self._effective.pop(name, None)
+            self._classes.pop(name, None)
             self._cond.notify_all()
 
     def set_effective(self, name: str, request: float, limit: float) -> bool:
@@ -527,8 +536,16 @@ class TokenScheduler:
             self._cond.notify_all()
 
     def _note_grant(self, name: str, wait_s: float, trace_id: str) -> None:
-        # caller holds self._cond; a timed-out wait raised before this
-        _GRANT_WAIT.observe(self.chip, value=wait_s)
+        # caller holds self._cond; a timed-out wait raised before this.
+        # Tenant attribution: client names are "namespace/pod" (the pod
+        # manager registers under the pod key); a bare name is its own
+        # tenant (tests, ad-hoc clients).
+        namespace = name.partition("/")[0]
+        tpu_class = self._classes.get(name, "best-effort")
+        _GRANT_WAIT.observe(self.chip, namespace, tpu_class,
+                            value=wait_s, exemplar=trace_id or None)
+        obs_slo.default_evaluator().record(
+            namespace, "grant-wait", value_s=wait_s, trace_id=trace_id)
         self._held_since[name] = time.monotonic()
         if trace_id:
             tracer = get_tracer()
@@ -543,6 +560,12 @@ class TokenScheduler:
         since = self._held_since.pop(name, None)
         if since is not None:
             _HOLD.observe(self.chip, value=time.monotonic() - since)
+        # black-box cadence (rate-limited inside): what this token was
+        # doing in the run-up to a trigger
+        flight_default_recorder().sample_deltas("tokensched-" + self.chip, {
+            "clients": float(len(self._shares)),
+            "waiting": float(sum(1 for q in self._waiting.values() if q)),
+        })
         try:
             usage = self._core.window_usage(name, self._clock())
         except (KeyError, RuntimeError):
@@ -571,7 +594,8 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
     """Expose a :class:`TokenScheduler` over framed-JSON TCP.
 
     Requests: ``{"op": "register", "name", "request", "limit"}`` (creates
-    the client; this connection owns it), ``{"op": "attach", "name"}``
+    the client; this connection owns it; optional ``"class"`` tags the
+    workload class for per-tenant metrics), ``{"op": "attach", "name"}``
     (binds an extra connection to an existing client — a pod manager's
     per-gate relay channels), ``{"op": "acquire"}`` (blocks; reply carries
     ``quota_ms``), ``{"op": "renew", "used_ms"}`` (atomic
@@ -593,7 +617,9 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
                 raise ValueError(
                     f"connection already bound to {state['name']!r}")
             name = req["name"]
-            scheduler.add_client(name, float(req["request"]), float(req["limit"]))
+            scheduler.add_client(name, float(req["request"]),
+                                 float(req["limit"]),
+                                 tpu_class=req.get("class", "best-effort"))
             state["name"] = name
             state["owner"] = True
             return {"ok": True}

@@ -8,10 +8,11 @@ the proxy's RPC latencies look like. The reference has neither (its only
 scheduler observability is log lines, SURVEY §5) — which is exactly how
 its 5-10 s Prometheus staleness bug stayed hidden.
 
-Two halves:
+Four quarters:
 
 - :mod:`.metrics` — labeled Counter/Gauge/Histogram primitives with a
-  strict Prometheus exposition renderer (``# HELP``/``# TYPE`` headers).
+  strict Prometheus exposition renderer (``# HELP``/``# TYPE`` headers)
+  and OpenMetrics exemplars on histogram buckets (``# {trace_id=...}``).
   One process-wide default registry; every component records into it and
   every ``/metrics`` endpoint appends its rendering.
 - :mod:`.trace` — lightweight spans (context managers, monotonic clocks,
@@ -19,22 +20,38 @@ Two halves:
   (Perfetto-loadable). Trace IDs thread submit → bind → token grant
   through the isolation protocol (``_trace`` message key), so one pod's
   timeline stitches end-to-end across layers.
+- :mod:`.slo` — per-tenant objectives (``sharedtpu/slo`` labels), rolling
+  error budgets, multi-window burn-rate alerting with a typed event
+  stream; deterministic on an injected clock.
+- :mod:`.flight` — the always-on flight recorder: a bounded ring of
+  recent spans/notes/alerts/metric deltas, dumped as a JSONL black box
+  when a trigger (alert, eviction, rollback, crash) fires.
 
 See ``doc/observability.md`` for the full metric/span catalogue.
 """
 
+from .flight import (FlightRecorder, default_recorder, dump_jsonl,
+                     install_crash_handler, parse_dump_jsonl)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry, lint_exposition, parse_exposition,
                       prom_escape, quantile_from_buckets, render_default,
-                      render_help_type, render_sample)
-from .trace import (Span, Tracer, get_tracer, install_tracer, new_trace_id,
-                    tracing_enabled, uninstall_tracer)
+                      render_exposition, render_help_type, render_sample)
+from .slo import (AlertEvent, SloError, SloEvaluator, SloSpec,
+                  default_evaluator, parse_slo, set_default_evaluator)
+from .trace import (Span, Tracer, add_span_sink, get_tracer, install_tracer,
+                    new_trace_id, remove_span_sink, tracing_enabled,
+                    uninstall_tracer)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry", "lint_exposition", "parse_exposition",
     "prom_escape", "quantile_from_buckets", "render_default",
-    "render_help_type", "render_sample",
-    "Span", "Tracer", "get_tracer", "install_tracer", "new_trace_id",
-    "tracing_enabled", "uninstall_tracer",
+    "render_exposition", "render_help_type", "render_sample",
+    "Span", "Tracer", "add_span_sink", "get_tracer", "install_tracer",
+    "new_trace_id", "remove_span_sink", "tracing_enabled",
+    "uninstall_tracer",
+    "AlertEvent", "SloError", "SloEvaluator", "SloSpec",
+    "default_evaluator", "parse_slo", "set_default_evaluator",
+    "FlightRecorder", "default_recorder", "dump_jsonl",
+    "install_crash_handler", "parse_dump_jsonl",
 ]

@@ -29,13 +29,26 @@ def prom_escape(value) -> str:
     return "".join(_LABEL_ESCAPES.get(ch, ch) for ch in str(value))
 
 
-def render_sample(name: str, labels: Optional[dict], value) -> str:
-    """One sample line: ``name{k="v",...} value`` (no trailing newline)."""
+def render_sample(name: str, labels: Optional[dict], value,
+                  exemplar: Optional[Tuple[str, float]] = None) -> str:
+    """One sample line: ``name{k="v",...} value`` (no trailing newline).
+
+    ``exemplar`` is an optional ``(trace_id, observed_value)`` pair
+    rendered in OpenMetrics syntax: ``... 17 # {trace_id="abc"} 0.043``.
+    Only histogram ``_bucket`` lines may carry one (enforced by
+    :func:`lint_exposition`, not here).
+    """
     if labels:
         body = ",".join('%s="%s"' % (k, prom_escape(v))
                         for k, v in sorted(labels.items()))
-        return "%s{%s} %s" % (name, body, _fmt_value(value))
-    return "%s %s" % (name, _fmt_value(value))
+        line = "%s{%s} %s" % (name, body, _fmt_value(value))
+    else:
+        line = "%s %s" % (name, _fmt_value(value))
+    if exemplar is not None:
+        trace_id, observed = exemplar
+        line += ' # {trace_id="%s"} %s' % (prom_escape(trace_id),
+                                           _fmt_value(observed))
+    return line
 
 
 def render_help_type(name: str, mtype: str, help_text: str) -> List[str]:
@@ -150,12 +163,15 @@ class Gauge(_Metric):
 
 
 class _HistSeries:
-    __slots__ = ("counts", "total", "count")
+    __slots__ = ("counts", "total", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets   # per-bucket (non-cumulative)
         self.total = 0.0
         self.count = 0
+        # bucket idx -> (trace_id, observed value); latest wins, so the
+        # exposition always links each bucket to a recent concrete trace
+        self.exemplars: Dict[int, Tuple[str, float]] = {}
 
 
 class Histogram(_Metric):
@@ -172,7 +188,12 @@ class Histogram(_Metric):
             bounds.append(math.inf)
         self.buckets = tuple(bounds)
 
-    def observe(self, *label_values, value: float) -> None:
+    def observe(self, *label_values, value: float,
+                exemplar: Optional[str] = None) -> None:
+        value = float(value)
+        if value != value:     # NaN sorts nowhere: bisect would pick an
+            raise ValueError(  # arbitrary bucket and poison _sum forever
+                "histogram %s cannot observe NaN" % self.name)
         key = self._key(label_values)
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
@@ -182,6 +203,8 @@ class Histogram(_Metric):
             series.counts[idx] += 1
             series.total += value
             series.count += 1
+            if exemplar:
+                series.exemplars[idx] = (str(exemplar), value)
 
     def snapshot(self, *label_values):
         """(cumulative bucket counts, sum, count) — for quantile math."""
@@ -196,14 +219,25 @@ class Histogram(_Metric):
                 cumulative.append(running)
             return cumulative, series.total, series.count
 
+    def exemplars(self, *label_values) -> Dict[float, Tuple[str, float]]:
+        """Latest ``{bucket upper bound: (trace_id, value)}`` per series."""
+        key = self._key(label_values)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                return {}
+            return {self.buckets[i]: ex
+                    for i, ex in series.exemplars.items()}
+
     def _render_series(self, labels: dict, series: _HistSeries) -> List[str]:
         lines, running = [], 0
-        for bound, c in zip(self.buckets, series.counts):
+        for i, (bound, c) in enumerate(zip(self.buckets, series.counts)):
             running += c
             bucket_labels = dict(labels)
             bucket_labels["le"] = _fmt_le(bound)
             lines.append(render_sample(self.name + "_bucket",
-                                       bucket_labels, running))
+                                       bucket_labels, running,
+                                       exemplar=series.exemplars.get(i)))
         lines.append(render_sample(self.name + "_sum", labels, series.total))
         lines.append(render_sample(self.name + "_count", labels,
                                    series.count))
@@ -322,11 +356,17 @@ HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
 TYPE_RE = re.compile(
     r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
     r"(counter|gauge|histogram|summary|untyped)$")
+_NUM = r"NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?"
 SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\[\\\"n])*\",?)*)\})?"
-    r" (NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)"
-    r"(?: [0-9]+)?$")
+    r" (" + _NUM + r")"
+    r"(?: [0-9]+)?"
+    # OpenMetrics exemplar: ` # {trace_id="..."} <value>` — anything
+    # else after the value (including a malformed exemplar) fails the
+    # whole line, which is how lint rejects bad exemplar syntax.
+    r"(?: # \{trace_id=\"((?:[^\"\\\n]|\\[\\\"n])*)\" *\} (" + _NUM + r"))?"
+    r"$")
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\[\\"n])*)"')
 
 
@@ -336,10 +376,13 @@ def _unescape(value: str) -> str:
 
 
 def parse_exposition(text: str) -> Dict[str, dict]:
-    """Parse exposition text into ``{family: {type, help, samples}}``.
+    """Parse exposition text into ``{family: {type, help, samples,
+    exemplars}}``.
 
     ``samples`` is a list of ``(name, labels_dict, value)``; histogram
     ``_bucket``/``_sum``/``_count`` samples attach to their base family.
+    ``exemplars`` is a list of ``(name, labels_dict, trace_id, value)``
+    for sample lines that carried an OpenMetrics exemplar.
     Raises ``ValueError`` on any malformed line — this doubles as the
     lint used by tests and ``scripts/trace_demo.py``.
     """
@@ -352,7 +395,8 @@ def parse_exposition(text: str) -> Dict[str, dict]:
                 base = name[:-len(suffix)]
                 break
         return families.setdefault(
-            base, {"type": None, "help": None, "samples": []})
+            base, {"type": None, "help": None, "samples": [],
+                   "exemplars": []})
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -375,15 +419,47 @@ def parse_exposition(text: str) -> Dict[str, dict]:
         labels = {k: _unescape(v)
                   for k, v in _LABEL_RE.findall(raw_labels or "")}
         value = float(raw_value.replace("Inf", "inf"))
-        family(name)["samples"].append((name, labels, value))
+        fam = family(name)
+        fam["samples"].append((name, labels, value))
+        if m.group(4) is not None:
+            fam["exemplars"].append(
+                (name, labels, _unescape(m.group(4)),
+                 float(m.group(5).replace("Inf", "inf"))))
     return families
+
+
+def render_exposition(families: Dict[str, dict]) -> str:
+    """Inverse of :func:`parse_exposition` — re-render parsed families.
+
+    ``parse(render(parse(text)))`` equals ``parse(text)`` for any text
+    rendered by this module, which is what the exemplar round-trip test
+    pins. HELP text is emitted verbatim (it is stored escaped).
+    """
+    lines: List[str] = []
+    for name in sorted(families):
+        fam = families[name]
+        if fam.get("help") is not None:
+            lines.append("# HELP %s %s" % (name, fam["help"]))
+        if fam.get("type") is not None:
+            lines.append("# TYPE %s %s" % (name, fam["type"]))
+        by_key = {}
+        for ex in fam.get("exemplars", ()):
+            ex_name, ex_labels, trace_id, observed = ex
+            by_key[(ex_name, tuple(sorted(ex_labels.items())))] = \
+                (trace_id, observed)
+        for sname, labels, value in fam.get("samples", ()):
+            ex = by_key.get((sname, tuple(sorted(labels.items()))))
+            lines.append(render_sample(sname, labels, value, exemplar=ex))
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def lint_exposition(text: str) -> List[str]:
     """Return lint errors (empty list == clean).
 
     Beyond line grammar: every family with samples must carry both a
-    ``# HELP`` and a ``# TYPE`` header.
+    ``# HELP`` and a ``# TYPE`` header, and exemplars may only ride
+    histogram ``_bucket`` lines (malformed exemplar syntax already
+    fails the line grammar inside :func:`parse_exposition`).
     """
     try:
         families = parse_exposition(text)
@@ -397,4 +473,8 @@ def lint_exposition(text: str) -> List[str]:
             errors.append("family %s has samples but no # TYPE" % name)
         if fam["help"] is None:
             errors.append("family %s has samples but no # HELP" % name)
+        for ex_name, _labels, _tid, _obs in fam.get("exemplars", ()):
+            if fam["type"] != "histogram" or not ex_name.endswith("_bucket"):
+                errors.append("exemplar on non-bucket sample %s "
+                              "(family %s)" % (ex_name, name))
     return errors

@@ -24,11 +24,47 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 def new_trace_id() -> str:
     return uuid.uuid4().hex
+
+
+# -- span sinks --------------------------------------------------------------
+# Observers of *completed* spans, fired regardless of whether a real
+# tracer is installed (the flight recorder must see spans even when the
+# bounded Tracer ring is not): both Tracer and _NullTracer emit from
+# finish()/record(). Sink errors are swallowed — observability must
+# never take down the operation it observes.
+
+_SINKS: List[Callable[["Span"], None]] = []
+_sinks_lock = threading.Lock()
+
+
+def add_span_sink(fn: Callable[["Span"], None]) -> Callable[["Span"], None]:
+    with _sinks_lock:
+        if fn not in _SINKS:
+            _SINKS.append(fn)
+    return fn
+
+
+def remove_span_sink(fn: Callable[["Span"], None]) -> None:
+    with _sinks_lock:
+        try:
+            _SINKS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _emit_span(span: "Span") -> None:
+    with _sinks_lock:
+        sinks = list(_SINKS)
+    for fn in sinks:
+        try:
+            fn(span)
+        except Exception:
+            pass
 
 
 def _new_span_id() -> str:
@@ -111,6 +147,7 @@ class Tracer:
     def finish(self, span: Span) -> Span:
         if span.end_ms is None:
             span.end_ms = self.now_ms()
+            _emit_span(span)
         return span
 
     def span(self, name: str, trace_id: str, parent_id: str = "",
@@ -132,6 +169,7 @@ class Tracer:
             self._spans.append(span)
             if len(self._spans) > self._capacity:
                 del self._spans[:len(self._spans) - self._capacity]
+        _emit_span(span)
         return span
 
     # -- reading / export ----------------------------------------------------
@@ -214,16 +252,21 @@ class _NullTracer(Tracer):
         super().__init__(capacity=0)
 
     def begin(self, name, trace_id, parent_id="", **attrs):
-        return Span(name=name, trace_id=trace_id, parent_id=parent_id)
+        return Span(name=name, trace_id=trace_id, parent_id=parent_id,
+                    attrs=dict(attrs))
 
     def finish(self, span):
-        span.end_ms = span.start_ms
+        if span.end_ms is None:
+            span.end_ms = span.start_ms
+            _emit_span(span)
         return span
 
     def record(self, name, trace_id, start_ms, end_ms, parent_id="",
                **attrs):
-        return Span(name=name, trace_id=trace_id, parent_id=parent_id,
-                    start_ms=start_ms, end_ms=end_ms)
+        span = Span(name=name, trace_id=trace_id, parent_id=parent_id,
+                    start_ms=start_ms, end_ms=end_ms, attrs=dict(attrs))
+        _emit_span(span)
+        return span
 
 
 _NULL = _NullTracer()

@@ -221,6 +221,23 @@ class ServiceClient:
             raise RuntimeError(f"/autopilot returned {code}")
         return body
 
+    def slo(self) -> dict:
+        """Per-tenant SLO snapshot (``GET /slo``): objectives, burn
+        rates, budget remaining, alert timeline. RuntimeError when the
+        scheduler predates the SLO plane."""
+        code, body = self._call("GET", "/slo")
+        if code != 200:
+            raise RuntimeError(f"/slo returned {code}")
+        return body
+
+    def flightrecorder(self) -> dict:
+        """Flight-recorder summary + latest black-box dump
+        (``GET /flightrecorder``)."""
+        code, body = self._call("GET", "/flightrecorder")
+        if code != 200:
+            raise RuntimeError(f"/flightrecorder returned {code}")
+        return body
+
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
 

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 
 from .. import constants as C
 from ..obs import metrics as obs_metrics
+from ..obs.flight import default_recorder
 from ..obs.trace import get_tracer
 from ..topology.cell import reclaim_resource, reserve_resource
 from ..utils.logger import get_logger
@@ -152,6 +153,10 @@ class Dispatcher:
         #: lease-driven failure detector (attach_healthwatch); polled
         #: from the step loop under the lock
         self.healthwatch = None
+        #: per-tenant SLO evaluator (attach_slo); evaluated every step
+        #: on the dispatcher clock so alert timelines are deterministic
+        #: under an injected clock
+        self.slo = None
         self.shed_total = 0
         self._next_gc = 0.0
         self._stop = False
@@ -162,6 +167,24 @@ class Dispatcher:
         it under the dispatcher lock, so detection → veto → eviction is
         serialized with scheduling decisions."""
         self.healthwatch = hw
+        return self
+
+    def attach_slo(self, evaluator) -> "Dispatcher":
+        """Wire an :class:`~..obs.slo.SloEvaluator`: queue-wait samples
+        and bind-availability outcomes feed it, every step re-evaluates
+        burn rates, and alert transitions land in the flight recorder —
+        a *firing* transition dumps the black box."""
+        self.slo = evaluator
+        rec = default_recorder()
+
+        def _on_alert(event):
+            rec.alert(event.to_dict())
+            if event.state == "firing":
+                rec.trigger("slo-alert", tenant=event.tenant,
+                            objective=event.objective,
+                            trace_id=event.trace_id)
+
+        evaluator.add_listener(_on_alert)
         return self
 
     @property
@@ -311,6 +334,22 @@ class Dispatcher:
                 # detection must never take the scheduling loop with it
                 log.exception("healthwatch poll failed")
 
+        if self.slo is not None:
+            try:
+                self.slo.evaluate(now)
+            except Exception:
+                # same contract as healthwatch: alerting rides the loop,
+                # it must never crash it
+                log.exception("slo evaluation failed")
+        # black-box cadence: cheap counter deltas so a dump shows what
+        # the dispatcher was doing in the seconds before the trigger
+        default_recorder().sample_deltas("dispatcher", {
+            "queued": float(len(self._pending)),
+            "parked": float(len(self._parked)),
+            "requeues_total": _REQUEUES.value(),
+            "timeouts_total": _TIMEOUTS.value(),
+        })
+
         for key in [k for k, p in self._parked.items() if p.deadline <= now]:
             if key in self._parked:     # may be gone via gang rejection
                 log.info("gang permit timeout for %s", key)
@@ -425,7 +464,10 @@ class Dispatcher:
         # is back-dated on the tracer clock, clamped into the root span so
         # fake-clock durations cannot escape the submit timeline.
         wait_s = max(0.0, now - pod.timestamp)
-        _QUEUE_WAIT.observe(value=wait_s)
+        _QUEUE_WAIT.observe(value=wait_s, exemplar=pod.trace_id)
+        if self.slo is not None:
+            self.slo.record(pod.namespace, "queue-wait", value_s=wait_s,
+                            now=now, trace_id=pod.trace_id)
         wait_end = tracer.now_ms()
         wait_start = wait_end - wait_s * 1000.0
         if pod.trace_span is not None:
@@ -763,6 +805,12 @@ class Dispatcher:
             evicted.append(key)
         log.warning("node %s lost: evicted %d pod(s): %s", node,
                     len(evicted), ", ".join(evicted))
+        # a node loss is a black-box trigger: dump what the system was
+        # doing in the run-up (doc/observability.md, flight recorder)
+        rec = default_recorder()
+        rec.note("dispatcher", "node-evicted", node=node, reason=reason,
+                 pods=len(evicted))
+        rec.trigger("node-eviction", node=node, pods=len(evicted))
         self._cond.notify_all()
         return evicted
 
@@ -799,6 +847,13 @@ class Dispatcher:
             log.warning("withdraw %s failed: %s", key, e)
 
     def _resolve(self, key: str, outcome: Outcome) -> None:
+        if self.slo is not None and outcome.status in (
+                "bound", "rejected", "timed-out"):
+            # availability SLI: did the tenant's pod reach bound?
+            # ("deleted"/"overloaded" are the user's own actions)
+            self.slo.record(key.partition("/")[0], "availability",
+                            ok=outcome.status == "bound",
+                            now=self._clock())
         self._results.pop(key, None)   # re-insert at the back (LRU order)
         self._results[key] = outcome
         self._last_reason.pop(key, None)

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 
 from .. import constants as C
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs.trace import get_tracer, new_trace_id
 from ..topology.cell import (CellConstructor, FreeList, build_cell_chains,
                              reclaim_resource, reserve_resource,
@@ -345,6 +346,11 @@ class SchedulerEngine:
         pod.trace_id = new_trace_id()
         pod.trace_span = get_tracer().begin("submit", pod.trace_id,
                                             pod=pod.key)
+        if pod.slo_specs:
+            # objectives are per tenant (namespace); declaring is
+            # idempotent, so every pod of the tenant may restate them
+            obs_slo.default_evaluator().declare(pod.namespace,
+                                                pod.slo_specs)
         self.pod_status[pod.key] = pod
         self.groups.get_or_create(pod)
         return pod

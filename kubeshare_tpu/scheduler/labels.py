@@ -62,6 +62,12 @@ class PodRequest:
     #: past it the dispatcher resolves the pod "timed-out" instead of
     #: retrying forever (sharedtpu/deadline, doc/health.md)
     deadline_s: float = 0.0
+    #: workload class for SLO attribution / priority isolation
+    #: (sharedtpu/class: latency | best-effort; absent = best-effort)
+    tpu_class: str = "best-effort"
+    #: parsed sharedtpu/slo objectives (list of obs.slo.SloSpec);
+    #: declared for the pod's namespace at submit
+    slo_specs: list = field(default_factory=list)
 
     group_name: str = ""
     headcount: int = 0
@@ -185,6 +191,22 @@ def parse_pod_labels(namespace: str, name: str, labels: dict,
     # deadline is orthogonal to the TPU labels: a regular workload can
     # carry one too (the dispatcher is its queue either way)
     pr.deadline_s = _parse_number(labels, C.POD_DEADLINE) or 0.0
+
+    # class + slo are likewise orthogonal: they shape observability and
+    # (ROADMAP item 1) isolation tier, not placement
+    raw_class = labels.get(C.POD_CLASS, "")
+    if raw_class:
+        if raw_class not in C.TPU_CLASSES:
+            raise LabelError(f"{C.POD_CLASS} must be one of "
+                             f"{C.TPU_CLASSES}, got {raw_class!r}")
+        pr.tpu_class = raw_class
+    raw_slo = labels.get(C.POD_SLO, "")
+    if raw_slo:
+        from ..obs.slo import SloError, parse_slo
+        try:
+            pr.slo_specs = parse_slo(raw_slo)
+        except SloError as exc:
+            raise LabelError(f"{C.POD_SLO}: {exc}")
 
     has_any = any(k in labels for k in
                   (C.POD_TPU_LIMIT, C.POD_TPU_REQUEST, C.POD_TPU_MEMORY))

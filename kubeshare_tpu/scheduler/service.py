@@ -25,6 +25,10 @@ API (JSON):
   (doc/autopilot.md; ``{"attached": false}`` when the plane is off)
 - ``POST /autopilot/plan``   dry-run: emit a migration plan, touch nothing
 - ``POST /autopilot/apply``  plan + execute one cycle (409 when detached)
+- ``GET  /slo``       per-tenant objectives, burn rates, budget remaining,
+  and the alert event timeline (doc/observability.md, SLO plane)
+- ``GET  /flightrecorder``  flight-recorder summary + the latest black-box
+  dump (always-on bounded ring; dumped on alert/eviction/crash triggers)
 - ``GET  /healthz``
 
 Overload shedding: with ``max_pending`` set, ``POST /schedule`` answers
@@ -43,6 +47,8 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs import flight as obs_flight
+from ..obs import slo as obs_slo
 from ..telemetry.aggregator import sync_engine_from_registry
 from ..telemetry.registry import RegistryClient, TelemetryRegistry
 from ..utils.logger import get_logger
@@ -72,6 +78,10 @@ class SchedulerService:
         self.healthwatch: HealthWatch | None = healthwatch or None
         if self.healthwatch is not None:
             self.dispatcher.attach_healthwatch(self.healthwatch)
+        # the SLO plane is always on (like the flight recorder): with no
+        # declared objectives evaluation is a no-op over an empty dict
+        self.slo = obs_slo.default_evaluator()
+        self.dispatcher.attach_slo(self.slo)
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
@@ -143,6 +153,17 @@ class SchedulerService:
         if self.autopilot is None:
             return {"attached": False, "enabled": False}
         return self.autopilot.snapshot()
+
+    def slo_state(self) -> dict:
+        """``GET /slo`` body: objectives, burn rates, alert timeline."""
+        return self.slo.state(now=self.dispatcher._clock())
+
+    def flightrecorder_state(self) -> dict:
+        """``GET /flightrecorder`` body: ring summary + latest dump."""
+        rec = obs_flight.default_recorder()
+        state = rec.state()
+        state["last"] = rec.last_dump()
+        return state
 
     def render_metrics(self) -> str:
         """Scheduler-side Prometheus exposition (the reference's only
@@ -239,6 +260,10 @@ class SchedulerService:
                     return self._reply(200, svc.health())
                 if self.path == "/autopilot":
                     return self._reply(200, svc.autopilot_state())
+                if self.path == "/slo":
+                    return self._reply(200, svc.slo_state())
+                if self.path == "/flightrecorder":
+                    return self._reply(200, svc.flightrecorder_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -347,7 +372,15 @@ def main(argv=None) -> None:
     parser.add_argument("--autopilot-journal", default="",
                         help="JSONL move journal path (crash-safe batch "
                              "recovery); empty = no journal")
+    parser.add_argument("--flight-dump-dir", default="",
+                        help="persist flight-recorder black-box dumps as "
+                             "JSONL files here (in-memory only when empty)")
     args = parser.parse_args(argv)
+
+    if args.flight_dump_dir:
+        obs_flight.default_recorder().set_dump_dir(args.flight_dump_dir)
+    # an unhandled exception dumps the black box before the process dies
+    obs_flight.install_crash_handler()
 
     config = load_config(args.config) if args.config else None
     engine = SchedulerEngine(config=config)

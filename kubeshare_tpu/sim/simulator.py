@@ -111,6 +111,12 @@ class SimStats:
     autopilot_rollbacks: int = 0
     autopilot_best_reduction: float = 0.0
     autopilot_log: list = field(default_factory=list)
+    # SLO plane in virtual time (doc/observability.md): every burn-rate
+    # alert transition the evaluator emitted during the replay, plus the
+    # (tenant, objective) pairs still firing when the trace drained —
+    # deterministic for a given seed/workload/slow-tenant injection
+    slo_events: list = field(default_factory=list)
+    slo_firing: list = field(default_factory=list)
 
     @property
     def mean_wait_s(self) -> float:
@@ -128,6 +134,9 @@ class SimStats:
             "makespan_s": round(self.makespan_s, 1),
             "per_node": self.per_node,
         }
+        if self.slo_events or self.slo_firing:
+            out["slo"] = {"events": self.slo_events,
+                          "firing": self.slo_firing}
         if self.autopilot_cycles:
             out["autopilot"] = {
                 "cycles": self.autopilot_cycles,
@@ -152,10 +161,31 @@ class Simulator:
     def __init__(self, engine: SchedulerEngine, seed: int = 0,
                  namespace: str = "sim", preempt: bool = False,
                  label_fn=None, failures: list | None = None,
-                 autopilot=None, autopilot_every: float = 0.0):
+                 autopilot=None, autopilot_every: float = 0.0,
+                 slo=None, slo_every: float = 15.0,
+                 slo_tenants: tuple = ("sim",),
+                 slow: tuple | None = None):
         self.engine = engine
         self.rng = random.Random(seed)
         self.namespace = namespace
+        #: a :class:`~..obs.slo.SloEvaluator` with objectives already
+        #: declared for ``slo_tenants``; the sim feeds it queue-wait and
+        #: availability SLIs in virtual time and runs ``evaluate`` every
+        #: ``slo_every`` virtual seconds — the burn-rate alert timeline
+        #: lands in :attr:`SimStats.slo_events`, deterministically
+        self.slo = slo
+        self.slo_every = slo_every
+        #: virtual tenants, assigned round-robin by submission index —
+        #: the per-tenant attribution axis without multiplying engine
+        #: namespaces
+        self.slo_tenants = tuple(slo_tenants) or ("sim",)
+        #: injected degradation ``(tenant, start_s, extra_wait_s)``: from
+        #: ``start_s`` on, that tenant's queue-wait SLI is reported
+        #: ``extra_wait_s`` worse than reality — the controlled burn the
+        #: alert pipeline must catch (placement itself is untouched, so
+        #: every other stat stays identical to the uninjected run)
+        self.slow = slow
+        self._tenant: dict[str, str] = {}
         #: an :class:`~..autopilot.Autopilot` over a Dispatcher sharing
         #: this engine; ``cycle()`` runs every ``autopilot_every``
         #: virtual seconds while jobs are live (doc/autopilot.md)
@@ -204,6 +234,9 @@ class Simulator:
             heapq.heappush(events, (self.autopilot_every, seq,
                                     "autopilot", None))
             seq += 1
+        if self.slo is not None and self.slo_every > 0:
+            heapq.heappush(events, (self.slo_every, seq, "slo", None))
+            seq += 1
         pending: list[tuple[str, TraceJob, float]] = []
         now = 0.0
 
@@ -248,6 +281,17 @@ class Simulator:
                 self._placed_once.add(name)
                 self.stats.placed += 1
                 self.stats.total_wait_s += now - submitted_at
+                if self.slo is not None:
+                    tenant = self._tenant.get(name, self.namespace)
+                    sli = now - submitted_at
+                    if (self.slow is not None
+                            and tenant == self.slow[0]
+                            and now >= self.slow[1]):
+                        sli += self.slow[2]
+                    self.slo.record(tenant, "queue-wait", value_s=sli,
+                                    now=now, trace_id=pod.trace_id)
+                    self.slo.record(tenant, "availability", ok=True,
+                                    now=now)
                 # first binds only: sum(per_node) == placed stays an
                 # invariant (restarts are counted separately above)
                 self.stats.per_node[binding.node] = (
@@ -273,6 +317,9 @@ class Simulator:
             if kind == "submit":
                 job = payload
                 name = f"job-{self.stats.submitted}"
+                if self.slo is not None:
+                    self._tenant[name] = self.slo_tenants[
+                        self.stats.submitted % len(self.slo_tenants)]
                 self.stats.submitted += 1
                 if not try_place(name, job, now):
                     pending.append((name, job, now))
@@ -299,6 +346,13 @@ class Simulator:
             elif kind == "recover":
                 self.engine.set_node_health(payload, True)
                 retry_pending()
+            elif kind == "slo":
+                for event in self.slo.evaluate(now):
+                    self.stats.slo_events.append(event.to_dict())
+                if self._live or pending:
+                    heapq.heappush(events, (now + self.slo_every, seq,
+                                            "slo", None))
+                    seq += 1
             elif kind == "autopilot":
                 res = self.autopilot.cycle(now=now)
                 if res.get("moves") or res.get("applied"):
@@ -339,7 +393,17 @@ class Simulator:
                 retry_pending()
         self.stats.failed = len(pending)
         for name, _, _ in pending:
+            if self.slo is not None:
+                # a job that never placed is an availability miss
+                self.slo.record(self._tenant.get(name, self.namespace),
+                                "availability", ok=False, now=now)
             self.engine.delete_pod(f"{self.namespace}/{name}")
+        if self.slo is not None:
+            for event in self.slo.evaluate(now):
+                self.stats.slo_events.append(event.to_dict())
+            self.stats.slo_firing = [
+                {"tenant": t, "objective": o}
+                for t, o in self.slo.firing()]
         self.stats.makespan_s = now
         return self.stats
 
@@ -388,6 +452,30 @@ def main(argv=None) -> None:
                              "virtual seconds (0 = autopilot off)")
     parser.add_argument("--autopilot-budget", type=int, default=8,
                         help="per-cycle migration budget")
+    parser.add_argument("--slo", default="", metavar="SPEC",
+                        help="declare per-tenant objectives using the "
+                             "sharedtpu/slo label grammar, e.g. "
+                             "'queue-wait-p99<=500ms,availability>=99' "
+                             "(doc/observability.md); the replay feeds "
+                             "the evaluator in virtual time and the "
+                             "alert timeline lands in the stats JSON")
+    parser.add_argument("--slo-tenants", type=int, default=2, metavar="N",
+                        help="spread jobs round-robin over N virtual "
+                             "tenants tenant-0..N-1 (with --slo)")
+    parser.add_argument("--slo-every", type=float, default=15.0,
+                        metavar="S",
+                        help="burn-rate evaluation cadence in virtual "
+                             "seconds (with --slo)")
+    parser.add_argument("--slow-tenant", default="", metavar="T@AT:EXTRA",
+                        help="inject a degradation: tenant T's "
+                             "queue-wait SLI reads EXTRA seconds worse "
+                             "from virtual time AT on — the controlled "
+                             "burn the alert pipeline must detect "
+                             "(with --slo)")
+    parser.add_argument("--flight-dump", default="", metavar="PATH",
+                        help="after the run, trigger a flight-recorder "
+                             "dump and write it to PATH as JSONL "
+                             "(doc/observability.md dump format)")
     args = parser.parse_args(argv)
 
     if sum(map(bool, (args.synthetic, args.trace, args.churn))) != 1:
@@ -438,10 +526,53 @@ def main(argv=None) -> None:
         autopilot = Autopilot(dispatcher, planner=planner,
                               rebalancer=Rebalancer(dispatcher,
                                                     planner=planner))
+    slo_ev = None
+    slo_tenants: tuple = ("sim",)
+    slow = None
+    if args.slo:
+        from ..obs import flight as obs_flight
+        from ..obs.slo import SloEvaluator, parse_slo
+
+        specs = parse_slo(args.slo)
+        slo_ev = SloEvaluator()
+        slo_tenants = tuple(f"tenant-{i}"
+                            for i in range(max(1, args.slo_tenants)))
+        for tenant in slo_tenants:
+            slo_ev.declare(tenant, specs)
+        rec = obs_flight.default_recorder()
+
+        def _on_alert(event, _rec=rec):
+            # same black-box contract as Dispatcher.attach_slo: every
+            # transition lands in the ring; a firing snapshots it
+            _rec.alert(event.to_dict())
+            if event.state == "firing":
+                _rec.trigger("slo-alert", tenant=event.tenant,
+                             objective=event.objective,
+                             trace_id=event.trace_id)
+        slo_ev.add_listener(_on_alert)
+        if args.slow_tenant:
+            try:
+                tenant, _, rest = args.slow_tenant.partition("@")
+                at, _, extra = rest.partition(":")
+                slow = (tenant, float(at), float(extra))
+            except ValueError:
+                parser.error("--slow-tenant wants T@AT:EXTRA, got "
+                             f"{args.slow_tenant!r}")
+    elif args.slow_tenant:
+        parser.error("--slow-tenant requires --slo")
     stats = Simulator(engine, seed=args.seed, preempt=args.preempt,
                       label_fn=label_fn, failures=failures,
                       autopilot=autopilot,
-                      autopilot_every=args.autopilot_every).run(jobs)
+                      autopilot_every=args.autopilot_every,
+                      slo=slo_ev, slo_every=args.slo_every,
+                      slo_tenants=slo_tenants, slow=slow).run(jobs)
+    if args.flight_dump:
+        from ..obs import flight as obs_flight
+        dump = obs_flight.default_recorder().trigger(
+            "sim-run", submitted=stats.submitted,
+            makespan_s=round(stats.makespan_s, 1))
+        with open(args.flight_dump, "w") as f:
+            f.write(obs_flight.dump_jsonl(dump))
     print(json.dumps(stats.to_json()))
 
 

@@ -304,13 +304,22 @@ def latency_snapshot(text: str) -> dict:
             key = tuple(sorted((k, v) for k, v in labels.items()
                                if k != "le"))
             s = series.setdefault(key, {"buckets": [], "sum": 0.0,
-                                        "count": 0})
+                                        "count": 0, "exemplar": None})
             if name.endswith("_bucket"):
                 s["buckets"].append((float(labels["le"]), value))
             elif name.endswith("_sum"):
                 s["sum"] = value
             elif name.endswith("_count"):
                 s["count"] = int(value)
+        # the slowest exemplar per series is the trace worth pulling
+        # from the flight recorder (doc/observability.md)
+        for _, labels, trace_id, value in fam.get("exemplars", ()):
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            s = series.get(key)
+            if s is not None and (s["exemplar"] is None
+                                  or value > s["exemplar"]["value"]):
+                s["exemplar"] = {"trace_id": trace_id, "value": value}
         for key, s in sorted(series.items()):
             bounds = [b for b, _ in sorted(s["buckets"])]
             cums = [int(c) for _, c in sorted(s["buckets"])]
@@ -322,6 +331,7 @@ def latency_snapshot(text: str) -> dict:
                 "p50": quantile_from_buckets(bounds, cums, 0.50),
                 "p90": quantile_from_buckets(bounds, cums, 0.90),
                 "p99": quantile_from_buckets(bounds, cums, 0.99),
+                "exemplar": s["exemplar"],
             })
 
     util = []
@@ -343,13 +353,16 @@ def render_latency(lat: dict, source: str) -> str:
                      "has been scheduled/executed since start")
     else:
         lines.append(f"  {'family':<42} {'labels':<22} {'count':>6} "
-                     f"{'p50':>8} {'p90':>8} {'p99':>8}")
+                     f"{'p50':>8} {'p90':>8} {'p99':>8}  exemplar")
         for r in rows:
             labels = ",".join(f"{k}={v}" for k, v in r["labels"].items())
+            ex = r.get("exemplar")
+            tail = (f"  {ex['trace_id'][:12]}"
+                    f" @{_fmt_seconds(ex['value'])}" if ex else "")
             lines.append(
                 f"  {r['family']:<42} {labels:<22} {r['count']:>6} "
                 f"{_fmt_seconds(r['p50']):>8} {_fmt_seconds(r['p90']):>8} "
-                f"{_fmt_seconds(r['p99']):>8}")
+                f"{_fmt_seconds(r['p99']):>8}{tail}")
     if lat["utilization"]:
         lines.append("TOKEN UTILIZATION (window share per chip)")
         for u in lat["utilization"]:
@@ -475,7 +488,13 @@ def main(argv=None) -> int:
                 target = metrics_url if args.latency else args.registry
                 print(f"kubeshare-top: {target} "
                       f"unreachable: {exc}", file=sys.stderr)
-                return 2
+                if args.watch <= 0:
+                    return 2
+                # watch mode rides out transient scrape failures (a
+                # restarting scheduler, a dropped frame) instead of
+                # dying mid-session; ctrl-c remains the exit
+                time.sleep(args.watch)
+                continue
             if args.watch > 0:
                 if args.json:
                     print(out, flush=True)  # one parseable frame per line

@@ -1,0 +1,232 @@
+"""SLO-plane micro-benchmark: what burn-rate alerting costs and how
+fast it detects (doc/observability.md).
+
+The SLO plane rides the hot paths — every token grant and every
+dispatcher cycle records a sample — so its cost per observation is the
+number that decides whether it can stay always-on. And the whole point
+of multi-window burn-rate alerting is bounded detection time: from the
+moment a tenant's SLI starts burning budget to the alert transition.
+This bench puts numbers on both:
+
+- ``record_us_p50`` / ``record_us_p99``: wall cost of one
+  ``SloEvaluator.record`` against a declared objective (lock + deque
+  append + prune + counter).
+- ``record_undeclared_ns``: cost of the drop path — a sample for a
+  tenant with no objectives (one dict lookup; this is what every
+  unopted tenant pays).
+- ``evaluate_us_p50``: one ``evaluate()`` pass over a populated fleet
+  (8 tenants x 2 objectives, both windows full of samples).
+- ``observe_ns`` / ``observe_exemplar_ns``: histogram observation
+  without/with an exemplar trace id — the exemplar surcharge on the
+  metrics hot path.
+- ``detection_latency_s_p50`` / ``_p99``: virtual-time experiments —
+  a tenant starts burning at t0 (samples each second), the evaluator
+  runs on the dispatcher cadence; detection is t(firing) - t0 across
+  seeds. Deterministic; bounded by min_samples + evaluation cadence.
+
+Run: ``python scripts/bench_slo.py`` → one JSON object (committed as
+``bench_slo.json``). ``--baseline FILE`` prints deltas; ``--write
+FILE`` saves fresh numbers (``make bench-slo`` does both). ``--check``
+exits non-zero unless the acceptance bars hold (always-on cost and
+bounded detection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line
+_METRICS = ("record_us_p50", "record_us_p99", "record_undeclared_ns",
+            "evaluate_us_p50", "observe_ns", "observe_exemplar_ns",
+            "detection_latency_s_p50", "detection_latency_s_p99")
+#: none of these are higher-is-better: every one is a cost or a latency
+_HIGHER_IS_BETTER = ()
+
+RECORD_N = 20_000
+EVALUATE_N = 500
+OBSERVE_N = 50_000
+DETECTION_SEEDS = 20
+EVAL_EVERY_S = 5.0           # the dispatcher-cadence stand-in
+
+
+def _quantiles(us: list) -> tuple:
+    s = sorted(us)
+    return s[len(s) // 2], s[min(len(s) - 1, int(len(s) * 0.99))]
+
+
+def bench_record() -> dict:
+    from kubeshare_tpu.obs.slo import SloEvaluator
+
+    ev = SloEvaluator()
+    ev.declare("bench", "grant-wait-p99<=50ms,availability>=99.9")
+    # pre-warm both objectives
+    ev.record("bench", "grant-wait", value_s=0.01, now=0.0)
+    costs = []
+    for i in range(RECORD_N):
+        v = 0.01 if i % 10 else 0.2            # ~10% bad samples
+        t0 = time.perf_counter()
+        ev.record("bench", "grant-wait", value_s=v, now=float(i) / 100.0,
+                  trace_id="bench-trace")
+        costs.append((time.perf_counter() - t0) * 1e6)
+    p50, p99 = _quantiles(costs)
+
+    t0 = time.perf_counter()
+    for i in range(RECORD_N):
+        ev.record("unopted", "grant-wait", value_s=0.01, now=float(i))
+    drop_ns = (time.perf_counter() - t0) / RECORD_N * 1e9
+
+    # evaluate over a populated fleet
+    fleet = SloEvaluator()
+    for t in range(8):
+        fleet.declare(f"tenant-{t}", "grant-wait-p99<=50ms,"
+                                     "availability>=99.9")
+        for i in range(600):
+            fleet.record(f"tenant-{t}", "grant-wait",
+                         value_s=0.01 if i % 7 else 0.2, now=float(i))
+            fleet.record(f"tenant-{t}", "availability", ok=bool(i % 11),
+                         now=float(i))
+    evals = []
+    for i in range(EVALUATE_N):
+        t0 = time.perf_counter()
+        fleet.evaluate(now=600.0 + i * 0.01)
+        evals.append((time.perf_counter() - t0) * 1e6)
+    return {"record_us_p50": round(p50, 3),
+            "record_us_p99": round(p99, 3),
+            "record_undeclared_ns": round(drop_ns, 1),
+            "evaluate_us_p50": round(_quantiles(evals)[0], 2)}
+
+
+def bench_observe() -> dict:
+    from kubeshare_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    hist = reg.histogram("bench_seconds", "bench", ("op",))
+    t0 = time.perf_counter()
+    for i in range(OBSERVE_N):
+        hist.observe("x", value=0.01)
+    plain = (time.perf_counter() - t0) / OBSERVE_N * 1e9
+    t0 = time.perf_counter()
+    for i in range(OBSERVE_N):
+        hist.observe("x", value=0.01, exemplar="0123456789abcdef")
+    with_ex = (time.perf_counter() - t0) / OBSERVE_N * 1e9
+    return {"observe_ns": round(plain, 1),
+            "observe_exemplar_ns": round(with_ex, 1)}
+
+
+def bench_detection() -> dict:
+    """Virtual-time: tenant burns from t0 on; how long to the firing
+    transition? Samples arrive every second (the grant cadence), the
+    evaluator runs every EVAL_EVERY_S (the dispatcher step cadence),
+    the burn starts at a seed-varied phase offset against that cadence
+    — detection latency is the phase-dependent tail, not noise."""
+    from kubeshare_tpu.obs.slo import SloEvaluator
+
+    latencies = []
+    for seed in range(DETECTION_SEEDS):
+        ev = SloEvaluator()   # stock windows/threshold/min_samples
+        ev.declare("t", "grant-wait-p99<=50ms")
+        burn_start = 120.0 + seed * (EVAL_EVERY_S / DETECTION_SEEDS)
+        fired_at = None
+        t, next_eval = 0.0, EVAL_EVERY_S
+        while t < burn_start + 300.0 and fired_at is None:
+            ev.record("t", "grant-wait",
+                      value_s=0.2 if t >= burn_start else 0.01, now=t)
+            while next_eval <= t:
+                for event in ev.evaluate(now=next_eval):
+                    if event.state == "firing":
+                        fired_at = next_eval
+                next_eval += EVAL_EVERY_S
+            t += 1.0
+        assert fired_at is not None, "burn must be detected"
+        latencies.append(fired_at - burn_start)
+    return {"detection_latency_s_p50": round(
+                statistics.median(latencies), 2),
+            "detection_latency_s_p99": round(max(latencies), 2),
+            "detection_eval_every_s": EVAL_EVERY_S,
+            "detection_seeds": DETECTION_SEEDS}
+
+
+def run_bench() -> dict:
+    out = {}
+    out.update(bench_record())
+    out.update(bench_observe())
+    out.update(bench_detection())
+    return out
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (doc/observability.md): the plane must be cheap
+    enough to stay always-on and detect inside one window."""
+    bars = [
+        ("record_us_p50", out["record_us_p50"] <= 50.0,
+         "record must stay in the tens of microseconds"),
+        ("record_undeclared_ns", out["record_undeclared_ns"] <= 5000.0,
+         "the unopted drop path must stay sub-5us"),
+        ("observe_exemplar_ns",
+         out["observe_exemplar_ns"] <= 20 * max(out["observe_ns"], 1.0)
+         or out["observe_exemplar_ns"] <= 20_000,
+         "exemplar surcharge must stay small"),
+        ("detection_latency_s_p99",
+         out["detection_latency_s_p99"]
+         <= 60.0 + 2 * EVAL_EVERY_S,
+         "detection must land inside the fast window + cadence"),
+    ]
+    failed = [f"{name}: {why} (got {out[name]})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:30s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:30s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_slo")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the always-on-cost and "
+                             "detection-latency bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

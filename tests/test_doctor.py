@@ -54,8 +54,8 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
                       "--scheduler", "127.0.0.1:1"])
     out = capsys.readouterr().out
     assert rc == 1
-    # registry + scheduler + autopilot + leases all refuse
-    assert out.count("fail") == 4
+    # registry + scheduler + autopilot + slo + leases all refuse
+    assert out.count("fail") == 5
 
 
 def test_doctor_cli_subprocess():
@@ -121,5 +121,5 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
                       "--scheduler", f"127.0.0.1:{ports[1]}"])
     out = capsys.readouterr().out
     assert rc == 1, out
-    # registry + scheduler + autopilot + leases all refuse
-    assert out.count("fail") == 4, out
+    # registry + scheduler + autopilot + slo + leases all refuse
+    assert out.count("fail") == 5, out

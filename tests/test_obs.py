@@ -321,3 +321,143 @@ def test_install_uninstall_null_tracer():
     finally:
         uninstall_tracer()
     assert not tracing_enabled()
+
+
+# -- exemplars (doc/observability.md) ----------------------------------------
+
+def _fresh_hist(name='t_ex_seconds'):
+    reg = m.MetricsRegistry()
+    return reg.histogram(name, 'test latencies', ('op',),
+                         buckets=(0.005, 0.05, 0.5)), reg
+
+
+def test_histogram_observe_exemplar_rendered_on_bucket_line():
+    hist, reg = _fresh_hist()
+    hist.observe('fwd', value=0.003, exemplar='abc123')
+    text = reg.render()
+    assert ('t_ex_seconds_bucket{le="0.005",op="fwd"} 1 '
+            '# {trace_id="abc123"} 0.003') in text
+    assert m.lint_exposition(text) == []
+    # the exemplar maps to the first bucket whose bound admits the value
+    assert hist.exemplars('fwd') == {0.005: ('abc123', 0.003)}
+
+
+def test_histogram_exemplar_latest_wins_per_bucket():
+    hist, _ = _fresh_hist()
+    hist.observe('x', value=0.001, exemplar='first')
+    hist.observe('x', value=0.002, exemplar='second')
+    hist.observe('x', value=0.1, exemplar='other-bucket')
+    assert hist.exemplars('x') == {0.005: ('second', 0.002),
+                                   0.5: ('other-bucket', 0.1)}
+
+
+def test_histogram_observe_without_exemplar_unchanged():
+    hist, reg = _fresh_hist()
+    hist.observe('x', value=0.003)
+    assert '# {' not in reg.render()
+    assert hist.exemplars('x') == {}
+
+
+def test_histogram_rejects_nan_observation():
+    hist, _ = _fresh_hist()
+    with pytest.raises(ValueError, match='NaN'):
+        hist.observe('x', value=float('nan'))
+
+
+def test_parse_exposition_surfaces_exemplars():
+    hist, reg = _fresh_hist()
+    hist.observe('fwd', value=0.003, exemplar='tr-1')
+    fams = m.parse_exposition(reg.render())
+    fam = fams['t_ex_seconds']
+    # samples stay 3-tuples (back-compat); exemplars ride separately
+    assert all(len(s) == 3 for s in fam['samples'])
+    assert fam['exemplars'] == [
+        ('t_ex_seconds_bucket', {'le': '0.005', 'op': 'fwd'},
+         'tr-1', 0.003)]
+
+
+def test_exemplar_round_trip_is_identity():
+    hist, reg = _fresh_hist()
+    hist.observe('fwd', value=0.003, exemplar='abc')
+    hist.observe('bwd', value=0.2, exemplar='de"f\\g')   # needs escaping
+    text = reg.render()
+    once = m.parse_exposition(text)
+    rendered = m.render_exposition(once)
+    assert m.parse_exposition(rendered) == once
+    # and a second render is byte-stable
+    assert m.render_exposition(m.parse_exposition(rendered)) == rendered
+
+
+def test_malformed_exemplars_rejected():
+    good = ('# HELP f_seconds h\n# TYPE f_seconds histogram\n'
+            'f_seconds_bucket{le="+Inf"} 1')
+    for bad_tail in (' # {trace_id="x"}',            # missing value
+                     ' # {trace_id=x} 1',            # unquoted id
+                     ' # {span_id="x"} 1',           # wrong key
+                     ' # trace_id="x" 1',            # no braces
+                     ' #{trace_id="x"} 1'):          # missing space
+        with pytest.raises(ValueError):
+            m.parse_exposition(good + bad_tail + '\n')
+
+
+def test_lint_rejects_exemplar_on_non_bucket_sample():
+    text = ('# HELP f_total c\n# TYPE f_total counter\n'
+            'f_total 1 # {trace_id="x"} 0.5\n')
+    fams = m.parse_exposition(text)            # grammar-valid...
+    errs = m.lint_exposition(text)             # ...but semantically not
+    assert fams['f_total']['exemplars']
+    assert any('non-bucket' in e for e in errs)
+    gauge = ('# HELP g a gauge\n# TYPE g gauge\n'
+             'g_bucket{le="1"} 1 # {trace_id="x"} 0.5\n')
+    assert any('non-bucket' in e for e in m.lint_exposition(gauge))
+
+
+# -- quantile/snapshot edge cases (satellite audit) --------------------------
+
+def test_quantile_empty_series():
+    assert math.isnan(m.quantile_from_buckets([], [], 0.5))
+    assert math.isnan(m.quantile_from_buckets([0.1, math.inf], [0, 0], 0.99))
+
+
+def test_quantile_all_in_inf_bucket():
+    # everything landed past the last finite bound: clamp to it
+    assert m.quantile_from_buckets([0.1, math.inf], [0, 5], 0.5) == 0.1
+    # ...unless +Inf is the ONLY bucket — no finite bound to clamp to
+    assert math.isnan(m.quantile_from_buckets([math.inf], [5], 0.5))
+
+
+def test_quantile_single_observation():
+    # one observation in the first bucket interpolates from 0
+    v = m.quantile_from_buckets([0.1, math.inf], [1, 1], 0.5)
+    assert 0.0 <= v <= 0.1
+
+
+def test_quantile_properties_randomized():
+    import random
+    rng = random.Random(7)
+    bounds = [0.005, 0.05, 0.5, 5.0, math.inf]
+    for _ in range(50):
+        counts = [rng.randint(0, 20) for _ in bounds]
+        cums, run = [], 0
+        for c in counts:
+            run += c
+            cums.append(run)
+        if run == 0:
+            assert math.isnan(m.quantile_from_buckets(bounds, cums, 0.9))
+            continue
+        qs = [m.quantile_from_buckets(bounds, cums, q)
+              for q in (0.1, 0.5, 0.9, 0.99)]
+        # monotone in q, and never past the last finite bound
+        assert qs == sorted(qs)
+        assert all(0.0 <= q <= 5.0 for q in qs)
+
+
+def test_histogram_snapshot_empty_and_single():
+    hist, _ = _fresh_hist()
+    cums, total, count = hist.snapshot('missing')
+    assert cums == [0, 0, 0, 0] and total == 0.0 and count == 0
+    hist.observe('one', value=0.01)
+    cums, total, count = hist.snapshot('one')
+    assert cums == [0, 1, 1, 1] and total == 0.01 and count == 1
+    # cumulative counts are monotone by construction
+    assert cums == sorted(cums)
