@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving image clean obs-check
 
 all: native
 
@@ -85,6 +85,15 @@ bench-autopilot:
 bench-slo:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_slo.py \
 		--baseline bench_slo.json --write bench_slo.json
+
+# Serving-plane bench (doc/serving.md): live tinymlp serving through a
+# real proxy session at target QPS, plus deterministic virtual-time
+# saturation/class-priority phases; --check gates the isolation-error
+# (<5%), shed-correctness (no admitted request dropped) and
+# latency-class-p99 bars, then refreshes bench_serving.json.
+bench-serving:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py --check \
+		--baseline bench_serving.json --write bench_serving.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

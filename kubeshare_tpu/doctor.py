@@ -206,6 +206,37 @@ def check_autopilot(addr: str, timeout_s: float,
         f"{state.get('rolled_back_total', 0)} rolled back")
 
 
+def check_serving(addr: str, timeout_s: float,
+                  defaulted: bool = False) -> bool:
+    """Serving-plane probe (doc/serving.md): ``/serving`` must answer;
+    no attached front door is a skip (the plane runs where the serving
+    process does), an attached one reports queues and shed totals."""
+    if not addr or addr == "none":
+        return _result("serving", "skip", "--scheduler none")
+    try:
+        state = json.loads(_get(f"http://{addr}/serving", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("serving", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("serving", "skip",
+                           "scheduler predates /serving")
+        return _result("serving", "fail", f"{addr}: {exc}")
+    if not state.get("attached"):
+        return _result("serving", "skip",
+                       "no front door attached (see doc/serving.md)")
+    totals = state.get("totals", {})
+    return _result(
+        "serving", "ok",
+        f"{addr}: {len(state.get('tenants', {}))} tenant(s), "
+        f"{totals.get('queued', 0)} queued, "
+        f"{totals.get('admitted', 0)} admitted / "
+        f"{totals.get('shed', 0)} shed, "
+        f"{state.get('batches', 0)} batch(es)")
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -382,6 +413,7 @@ def main(argv=None) -> int:
     ok &= check_registry(registry, 5.0, defaulted=reg_defaulted)
     ok &= check_scheduler(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_autopilot(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_serving(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_slo(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name

@@ -221,6 +221,15 @@ class ServiceClient:
             raise RuntimeError(f"/autopilot returned {code}")
         return body
 
+    def serving(self) -> dict:
+        """Serving front-door join view (``GET /serving``,
+        doc/serving.md); ``{"attached": false}`` when no front door is
+        wired, RuntimeError when the scheduler predates it."""
+        code, body = self._call("GET", "/serving")
+        if code != 200:
+            raise RuntimeError(f"/serving returned {code}")
+        return body
+
     def slo(self) -> dict:
         """Per-tenant SLO snapshot (``GET /slo``): objectives, burn
         rates, budget remaining, alert timeline. RuntimeError when the

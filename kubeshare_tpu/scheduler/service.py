@@ -25,6 +25,9 @@ API (JSON):
   (doc/autopilot.md; ``{"attached": false}`` when the plane is off)
 - ``POST /autopilot/plan``   dry-run: emit a migration plan, touch nothing
 - ``POST /autopilot/apply``  plan + execute one cycle (409 when detached)
+- ``GET  /serving``   serving front-door join view: per-tenant queues,
+  admit/shed totals, batch stats (doc/serving.md; ``{"attached":
+  false}`` when no front door is wired)
 - ``GET  /slo``       per-tenant objectives, burn rates, budget remaining,
   and the alert event timeline (doc/observability.md, SLO plane)
 - ``GET  /flightrecorder``  flight-recorder summary + the latest black-box
@@ -85,12 +88,19 @@ class SchedulerService:
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
+        self.serving = None
 
     def attach_autopilot(self, autopilot) -> "SchedulerService":
         """Wire an :class:`~..autopilot.Autopilot` built over
         ``self.dispatcher`` (doc/autopilot.md); exposes it on
         ``/autopilot``."""
         self.autopilot = autopilot
+        return self
+
+    def attach_serving(self, frontdoor) -> "SchedulerService":
+        """Wire a serving :class:`~..serving.FrontDoor` (doc/serving.md);
+        exposes its join view on ``/serving``."""
+        self.serving = frontdoor
         return self
 
     # -- operations --------------------------------------------------------
@@ -153,6 +163,12 @@ class SchedulerService:
         if self.autopilot is None:
             return {"attached": False, "enabled": False}
         return self.autopilot.snapshot()
+
+    def serving_state(self) -> dict:
+        """``GET /serving`` body; cheap when no front door is wired."""
+        if self.serving is None:
+            return {"attached": False}
+        return self.serving.state()
 
     def slo_state(self) -> dict:
         """``GET /slo`` body: objectives, burn rates, alert timeline."""
@@ -260,6 +276,8 @@ class SchedulerService:
                     return self._reply(200, svc.health())
                 if self.path == "/autopilot":
                     return self._reply(200, svc.autopilot_state())
+                if self.path == "/serving":
+                    return self._reply(200, svc.serving_state())
                 if self.path == "/slo":
                     return self._reply(200, svc.slo_state())
                 if self.path == "/flightrecorder":

@@ -1,0 +1,26 @@
+"""Serving plane: continuous-batching inference over fractional chips.
+
+- :mod:`.frontdoor` — per-tenant queues, token-bucket + fair-share
+  admission (typed ``Overloaded`` → 429), class-aware dequeue,
+  park/resume of tenant sessions;
+- :mod:`.batcher` — coalesces compatible requests into one shared
+  execute per batch, bounded by ``max_batch`` and ``max_wait_s``;
+- :mod:`.accounting` — tokens/bytes/executions per (tenant, class)
+  with exemplar-carrying latency histograms;
+- :mod:`.simulate` — deterministic virtual-time replay for
+  ``sim --serve`` and tests.
+
+See doc/serving.md for the request lifecycle.
+"""
+
+from .accounting import ServingAccounting
+from .batcher import ContinuousBatcher, LocalServable, ProxyServable
+from .frontdoor import (FrontDoor, ServeRequest, SessionParked,
+                        TokenBucket)
+from .simulate import simulate_serving
+
+__all__ = [
+    "ServingAccounting", "ContinuousBatcher", "LocalServable",
+    "ProxyServable", "FrontDoor", "ServeRequest", "SessionParked",
+    "TokenBucket", "simulate_serving",
+]

@@ -472,15 +472,74 @@ def main(argv=None) -> None:
                              "from virtual time AT on — the controlled "
                              "burn the alert pipeline must detect "
                              "(with --slo)")
+    parser.add_argument("--serve", type=int, default=0, metavar="N",
+                        help="replay N inference-request arrivals "
+                             "through the serving front door + "
+                             "continuous batcher in virtual time "
+                             "(doc/serving.md) — seeded Poisson "
+                             "arrivals, deterministic stats; mutually "
+                             "exclusive with the placement traces")
+    parser.add_argument("--serve-tenants", type=int, default=4,
+                        metavar="N",
+                        help="number of synthetic serving tenants "
+                             "(with --serve)")
+    parser.add_argument("--serve-qps", type=float, default=200.0,
+                        help="aggregate offered load in requests/s, "
+                             "split evenly across tenants (with "
+                             "--serve)")
+    parser.add_argument("--serve-latency-tenants", type=int, default=1,
+                        metavar="K",
+                        help="the first K serving tenants are "
+                             "sharedtpu/class latency; the rest are "
+                             "best-effort (with --serve)")
+    parser.add_argument("--serve-rate", type=float, default=0.0,
+                        help="per-tenant token-bucket admission cap in "
+                             "requests/s (0 = uncapped; with --serve)")
     parser.add_argument("--flight-dump", default="", metavar="PATH",
                         help="after the run, trigger a flight-recorder "
                              "dump and write it to PATH as JSONL "
                              "(doc/observability.md dump format)")
     args = parser.parse_args(argv)
 
-    if sum(map(bool, (args.synthetic, args.trace, args.churn))) != 1:
+    if sum(map(bool, (args.synthetic, args.trace, args.churn,
+                      args.serve))) != 1:
         parser.error("exactly one of --trace / --synthetic / --churn "
-                     "is required")
+                     "/ --serve is required")
+    if args.serve:
+        from ..obs import flight as obs_flight
+        from ..serving import simulate_serving
+
+        slo_ev = None
+        if args.slo:
+            from ..obs.slo import SloEvaluator, parse_slo
+
+            specs = parse_slo(args.slo)
+            slo_ev = SloEvaluator()
+            for i in range(max(1, args.serve_tenants)):
+                slo_ev.declare(f"tenant-{i}", specs)
+            rec = obs_flight.default_recorder()
+
+            def _on_serve_alert(event, _rec=rec):
+                _rec.alert(event.to_dict())
+                if event.state == "firing":
+                    _rec.trigger("slo-alert", tenant=event.tenant,
+                                 objective=event.objective,
+                                 trace_id=event.trace_id)
+            slo_ev.add_listener(_on_serve_alert)
+        out = simulate_serving(
+            n_requests=args.serve, tenants=args.serve_tenants,
+            qps=args.serve_qps, seed=args.seed,
+            latency_tenants=args.serve_latency_tenants,
+            rate=args.serve_rate or None,
+            slo=slo_ev, slo_every_s=args.slo_every)
+        if args.flight_dump:
+            dump = obs_flight.default_recorder().trigger(
+                "sim-run", served=out["completed"],
+                shed=out["shed"])
+            with open(args.flight_dump, "w") as f:
+                f.write(obs_flight.dump_jsonl(dump))
+        print(json.dumps({"serving": out}))
+        return
     if args.synthetic:
         import random
         jobs = synthesize_trace(args.synthetic, random.Random(args.seed))

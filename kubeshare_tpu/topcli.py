@@ -12,7 +12,7 @@ Usage::
     python -m kubeshare_tpu.topcli [--registry HOST:PORT] [--node N]
                                    [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json] [--latency]
-                                   [--health] [--autopilot]
+                                   [--health] [--autopilot] [--serving]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
 ``--latency`` switches from the fleet table to the self-observability
@@ -28,6 +28,9 @@ the scheduler's ``/health`` (state machine, shed/evicted totals).
 (``doc/autopilot.md``): cluster fragmentation score, pending/applied
 moves and per-chip burst credits from the scheduler's ``/autopilot``,
 joined with the registry's capacity and lease views.
+``--serving`` renders the inference front door (``doc/serving.md``):
+per-tenant queue depth, admit/shed totals and request p50/p99 from the
+scheduler's ``/serving``, joined with the registry's capacity view.
 Exit 0 on a healthy read, 2 when the registry is unreachable.
 """
 
@@ -275,6 +278,67 @@ def render_autopilot(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def serving_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Serving join (doc/serving.md): the scheduler's ``/serving`` view
+    (per-tenant queue depth, admit/shed totals, p50/p99) over the
+    registry's capacity view, so operators see the front door and the
+    fleet it is carving batches out of in one frame."""
+    state: dict = {}
+    if scheduler is not None:
+        try:
+            state = scheduler.serving()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "serving state unavailable, showing capacity only",
+                  file=sys.stderr)
+    try:
+        capacity = client.capacity()
+        chips = sum(len(e.get("chips", [])) for e in capacity.values())
+    except Exception:
+        chips = 0
+    return {"serving": state or {"attached": False},
+            "chips": chips}
+
+
+def render_serving(snap: dict) -> str:
+    sv = snap["serving"]
+    lines = ["SERVING (continuous-batching front door, doc/serving.md)"]
+    if not sv.get("attached"):
+        lines.append("  not attached — run a serving front door and "
+                     "attach_serving() it to the scheduler")
+        return "\n".join(lines)
+    tot = sv.get("totals", {})
+    lines.append(
+        f"  {tot.get('admitted', 0)} admitted / {tot.get('shed', 0)} "
+        f"shed / {tot.get('completed', 0)} completed  "
+        f"queued {tot.get('queued', 0)}  "
+        f"batches {sv.get('batches', 0)} "
+        f"(mean {sv.get('mean_batch_rows', 0.0):.1f} rows)"
+        + (f"  over {snap['chips']} chip(s)" if snap.get("chips")
+           else ""))
+    bt = sv.get("batcher") or {}
+    if bt:
+        lines.append(
+            f"  knobs: max_batch {bt.get('max_batch')}  "
+            f"max_wait {_fmt_seconds(float(bt.get('max_wait_s', 0.0)))}  "
+            f"executions {bt.get('executions', 0)}")
+    tenants = sv.get("tenants", {})
+    if tenants:
+        lines.append(f"  {'tenant':<20} {'class':<12} {'queued':>6} "
+                     f"{'admit':>6} {'shed':>5} {'done':>6} "
+                     f"{'tokens':>7} {'p50':>8} {'p99':>8}")
+        for name in sorted(tenants):
+            t = tenants[name]
+            lines.append(
+                f"  {name:<20} {t.get('class', '?'):<12} "
+                f"{t.get('queued', 0):>6} {t.get('admitted', 0):>6} "
+                f"{t.get('shed', 0):>5} {t.get('completed', 0):>6} "
+                f"{t.get('tokens', 0):>7} "
+                f"{_fmt_seconds(t.get('p50_ms', 0.0) / 1e3):>8} "
+                f"{_fmt_seconds(t.get('p99_ms', 0.0) / 1e3):>8}")
+    return "\n".join(lines)
+
+
 def _fmt_seconds(s: float) -> str:
     if s != s:                       # NaN: series exists but has no samples
         return "-"
@@ -444,6 +508,11 @@ def main(argv=None) -> int:
                              "and per-chip burst credits (needs "
                              "--scheduler for autopilot state) instead "
                              "of the fleet table")
+    parser.add_argument("--serving", action="store_true",
+                        help="serving front-door join: per-tenant queue "
+                             "depth, admit/shed rates and p50/p99 (needs "
+                             "--scheduler for /serving state) instead "
+                             "of the fleet table")
     args = parser.parse_args(argv)
     host, _, port = args.registry.rpartition(":")
     client = RegistryClient(host or "127.0.0.1", int(port))
@@ -474,6 +543,10 @@ def main(argv=None) -> int:
                     aps = autopilot_snapshot(client, scheduler)
                     out = (json.dumps(aps) if args.json
                            else render_autopilot(aps))
+                elif args.serving:
+                    svs = serving_snapshot(client, scheduler)
+                    out = (json.dumps(svs) if args.json
+                           else render_serving(svs))
                 elif args.health:
                     hs = health_snapshot(client, scheduler)
                     out = json.dumps(hs) if args.json else render_health(hs)

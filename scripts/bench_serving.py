@@ -1,0 +1,285 @@
+"""Serving-plane benchmark: N synthetic tenants against one
+fractionally-held chip (doc/serving.md).
+
+The serving plane's promises are quantitative, so they get a bench:
+
+- **steady**: live wall-clock serving — 4 tenant driver threads at a
+  target aggregate QPS push tinymlp requests through a real
+  ``ChipProxy`` session (``ProxyServable``: params staged once, each
+  batch one framed execute under token scheduling); reports achieved
+  QPS, request p50/p99, and that every admitted request completed.
+- **saturation** (virtual time, deterministic): offered load 2x the
+  modeled capacity, equal per-tenant load — per-tenant *isolation
+  error* (max deviation of completed requests from the same-class
+  mean) and the shed ratio. Graceful shedding means 429s at admission
+  and zero admitted-but-dropped requests.
+- **class priority** (virtual time, deterministic): one latency-class
+  tenant at modest QPS, alone vs under a 3-tenant best-effort flood —
+  its p99 must not degrade materially (latency-first dequeue).
+- **park/resume**: wall cost of freezing a tenant session (64 queued
+  requests) into a manifest and replaying it into a fresh front door.
+
+Run: ``python scripts/bench_serving.py`` → one JSON object (committed
+as ``bench_serving.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers (``make bench-serving`` does
+both). ``--check`` exits non-zero unless the acceptance bars hold
+(ISSUE 7: isolation error <5%, no admitted request dropped, latency
+p99 unaffected by the flood, target QPS reached).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line
+_METRICS = ("achieved_qps", "steady_p50_ms", "steady_p99_ms",
+            "isolation_error", "shed_ratio", "lat_p99_alone_ms",
+            "lat_p99_flood_ms", "park_resume_ms", "mean_batch_rows")
+#: larger is better only for throughput and batch occupancy
+_HIGHER_IS_BETTER = ("achieved_qps", "mean_batch_rows")
+
+WINDOW, BASE, MIN = 1000.0, 100.0, 10.0
+TENANTS = 4
+TARGET_QPS = 240.0           # aggregate, split evenly across tenants
+STEADY_S = 1.5
+
+
+def bench_steady() -> dict:
+    """Live serving through a real proxy session at TARGET_QPS."""
+    import numpy as np
+
+    from kubeshare_tpu.isolation.client import ProxyClient
+    from kubeshare_tpu.isolation.proxy import ChipProxy
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+    from kubeshare_tpu.models import tinymlp
+    from kubeshare_tpu.obs.metrics import MetricsRegistry
+    from kubeshare_tpu.serving import (ContinuousBatcher, FrontDoor,
+                                       ProxyServable, ServingAccounting)
+
+    proxy = ChipProxy(scheduler=TokenScheduler(WINDOW, BASE, MIN))
+    proxy.serve()
+    client = ProxyClient("127.0.0.1", proxy.port, "serving", 0.5, 1.0)
+    servable = ProxyServable(client, seed=0)
+    fd = FrontDoor(max_queue=512,
+                   accounting=ServingAccounting(MetricsRegistry()))
+    batcher = ContinuousBatcher(fd, servable, max_wait_s=0.004)
+    stop = threading.Event()
+    pump = threading.Thread(target=batcher.serve_loop, args=(stop,),
+                            daemon=True)
+    pump.start()
+
+    per_tenant = TARGET_QPS / TENANTS
+    period = 1.0 / per_tenant
+    latencies: list = []
+    lat_lock = threading.Lock()
+    counts = {"offered": 0, "admitted": 0, "completed": 0}
+
+    def drive(tenant: str) -> None:
+        rng = np.random.default_rng(hash(tenant) % 2**32)
+        x = rng.standard_normal((1, tinymlp.FEATURES)).astype(np.float32)
+        deadline = time.monotonic()
+        end = deadline + STEADY_S
+        mine = []
+        n_off = n_adm = n_done = 0
+        while deadline < end:
+            now = time.monotonic()
+            if now < deadline:
+                time.sleep(deadline - now)
+            deadline += period
+            n_off += 1
+            t0 = time.monotonic()
+            req = fd.submit(tenant, x)     # uncapped: must not shed
+            n_adm += 1
+            req.result(timeout=10.0)
+            mine.append((time.monotonic() - t0) * 1e3)
+            n_done += 1
+        with lat_lock:
+            latencies.extend(mine)
+            counts["offered"] += n_off
+            counts["admitted"] += n_adm
+            counts["completed"] += n_done
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=drive, args=(f"tenant-{i}",))
+               for i in range(TENANTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+    stop.set()
+    pump.join(timeout=2.0)
+    servable.close()
+    proxy.close()
+    lat = sorted(latencies)
+    snap = fd.accounting.snapshot()
+    return {
+        "tenants": TENANTS,
+        "target_qps": TARGET_QPS,
+        "achieved_qps": round(counts["completed"] / elapsed, 1),
+        "steady_p50_ms": round(lat[len(lat) // 2], 3),
+        "steady_p99_ms": round(lat[min(len(lat) - 1,
+                                       int(len(lat) * 0.99))], 3),
+        "steady_dropped": counts["admitted"] - counts["completed"],
+        "mean_batch_rows": snap["mean_batch_rows"],
+    }
+
+
+def bench_saturation() -> dict:
+    """Virtual time: 2x capacity offered, equal share per tenant."""
+    from kubeshare_tpu.serving import simulate_serving
+
+    # capacity = max_batch/exec_time = 800 rows/s; offer 1600.
+    out = simulate_serving(n_requests=1200, tenants=TENANTS,
+                           qps=1600.0, seed=11, latency_tenants=0,
+                           max_batch=8, exec_time_s=0.01,
+                           max_wait_s=0.02, max_queue=24)
+    return {
+        "isolation_error": out["isolation_error"],
+        "shed_ratio": round(out["shed"] / out["offered"], 4),
+        "saturation_dropped": out["dropped"],
+        "saturation_admitted": out["admitted"],
+        "saturation_completed": out["completed"],
+    }
+
+
+def bench_class_priority() -> dict:
+    """Virtual time: latency tenant p99 alone vs under a BE flood."""
+    from kubeshare_tpu.serving import simulate_serving
+
+    alone = simulate_serving(n_requests=200, tenants=1, qps=100.0,
+                             seed=5, latency_tenants=1, max_batch=8,
+                             exec_time_s=0.01, max_wait_s=0.02,
+                             max_queue=64)
+    # same latency tenant rate (100 qps of the 1600 aggregate), plus
+    # three best-effort tenants flooding well past capacity
+    flood = simulate_serving(n_requests=1600, tenants=4, qps=1600.0,
+                             seed=5, latency_tenants=1, max_batch=8,
+                             exec_time_s=0.01, max_wait_s=0.02,
+                             max_queue=64)
+    return {
+        "lat_p99_alone_ms": alone["tenants"]["tenant-0"]["p99_ms"],
+        "lat_p99_flood_ms": flood["tenants"]["tenant-0"]["p99_ms"],
+        "flood_be_p99_ms": max(
+            rec["p99_ms"] for name, rec in flood["tenants"].items()
+            if rec["class"] == "best-effort"),
+    }
+
+
+def bench_park_resume() -> dict:
+    """Wall cost of park -> manifest -> resume for a loaded session."""
+    import numpy as np
+
+    from kubeshare_tpu.obs.metrics import MetricsRegistry
+    from kubeshare_tpu.serving import (ContinuousBatcher, FrontDoor,
+                                       LocalServable, ServingAccounting)
+
+    samples = []
+    for _ in range(20):
+        fd = FrontDoor(max_queue=256,
+                       accounting=ServingAccounting(MetricsRegistry()))
+        fd.register_tenant("park", tpu_class="latency")
+        for i in range(64):
+            fd.submit("park", np.full((1, 16), i, np.float32))
+        t0 = time.perf_counter()
+        manifest = fd.park("park")
+        fd2 = FrontDoor(max_queue=256,
+                        accounting=ServingAccounting(MetricsRegistry()))
+        restored = fd2.resume(manifest)
+        samples.append((time.perf_counter() - t0) * 1e3)
+        assert len(restored) == 64
+        batcher = ContinuousBatcher(fd2, LocalServable(lambda x: x, 8))
+        batcher.flush(time.monotonic())
+        assert all(r.done for r in restored)
+    samples.sort()
+    return {"park_resume_ms": round(samples[len(samples) // 2], 3),
+            "park_resume_requests": 64}
+
+
+def run_bench() -> dict:
+    out = {}
+    out.update(bench_steady())
+    out.update(bench_saturation())
+    out.update(bench_class_priority())
+    out.update(bench_park_resume())
+    return out
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (ISSUE 7 / doc/serving.md)."""
+    bars = [
+        ("achieved_qps", out["achieved_qps"] >= 0.9 * TARGET_QPS,
+         f"must serve >=90% of the {TARGET_QPS} qps target"),
+        ("steady_dropped", out["steady_dropped"] == 0,
+         "no admitted request may be dropped in steady state"),
+        ("isolation_error", out["isolation_error"] < 0.05,
+         "per-tenant isolation error must stay under 5% saturated"),
+        ("shed_ratio", out["shed_ratio"] > 0.2,
+         "past saturation the front door must shed, not queue forever"),
+        ("saturation_dropped", out["saturation_dropped"] == 0,
+         "every admitted request completes even past saturation"),
+        ("lat_p99_flood_ms",
+         out["lat_p99_flood_ms"]
+         <= max(2.5 * out["lat_p99_alone_ms"], 50.0),
+         "a best-effort flood must not inflate latency-class p99"),
+    ]
+    failed = [f"{name}: {why} (got {out[name]})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:30s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:30s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_serving")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the isolation-error, "
+                             "shed-correctness and class-priority bars "
+                             "hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

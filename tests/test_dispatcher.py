@@ -7,7 +7,7 @@ import pytest
 
 from kubeshare_tpu import constants as C
 from kubeshare_tpu.scheduler import SchedulerEngine
-from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+from kubeshare_tpu.scheduler.dispatcher import Dispatcher, Overloaded
 from kubeshare_tpu.scheduler.service import SchedulerService
 from kubeshare_tpu.telemetry import TelemetryRegistry
 from kubeshare_tpu.topology.discovery import FakeTopology
@@ -480,3 +480,62 @@ def test_guarantee_gang_preempts_its_way_in(clock):
                                     for i in range(2)]
     assert "ns/opp0" not in eng.pod_status
     assert "ns/opp1" not in eng.pod_status
+
+
+def test_max_pending_one_beats_fair_share_across_namespaces(clock):
+    """``max_pending=1`` with several active namespaces: the global
+    bound fires before the fair-share floor ever can (total >= 1 the
+    moment anything is pending), so every later namespace sheds with
+    reason ``max-pending`` — never ``fair-share``."""
+    eng = make_engine(mesh=(2,), clock=clock)
+    d = Dispatcher(eng, clock=clock, max_pending=1)
+    d.submit("ns-a", "p0", shared())
+    for ns in ("ns-b", "ns-c"):
+        with pytest.raises(Overloaded) as exc:
+            d.submit(ns, "q0", shared())
+        assert exc.value.reason == "max-pending"
+        assert d.status(f"{ns}/q0")["status"] == "overloaded"
+    assert d.shed_total == 2
+    # the resubmit exemption still applies at the tightest bound: a
+    # poll/retry of the pod already holding the queue is not new load
+    d.submit("ns-a", "p0", shared())
+    assert d.shed_total == 2
+
+
+def test_fair_share_floor_caps_hog_before_global_bound(clock):
+    """With ``max_pending=4`` and two namespaces the share is 2: the
+    hog's third submit sheds ``fair-share`` while the small tenant
+    still gets in; only once the queue is truly full does the reason
+    flip to ``max-pending``."""
+    eng = make_engine(mesh=(1,), clock=clock)
+    d = Dispatcher(eng, clock=clock, max_pending=4)
+    d.submit("hog", "a0", shared())
+    d.submit("hog", "a1", shared())
+    d.submit("small", "b0", shared())       # share=2, mine=0: admitted
+    with pytest.raises(Overloaded) as exc:
+        d.submit("hog", "a2", shared())     # share=2, mine=2: capped
+    assert exc.value.reason == "fair-share"
+    assert "fair share" in str(exc.value)
+    d.submit("small", "b1", shared())       # mine=1 < share: admitted
+    with pytest.raises(Overloaded) as exc:
+        d.submit("third", "c0", shared())   # total=4: global bound
+    assert exc.value.reason == "max-pending"
+    assert d.shed_total == 2
+
+
+def test_resubmit_of_bound_pod_exempt_under_full_queue(clock):
+    """A resubmit of a pod the engine already binds (kubelet replay
+    after apiserver hiccup) passes even when the admission queue is
+    full — only genuinely NEW load is shed."""
+    eng = make_engine(mesh=(1,), clock=clock)
+    d = Dispatcher(eng, clock=clock, max_pending=1)
+    d.submit("ns", "held", shared("1", "1"))
+    d.step()
+    assert d.status("ns/held")["status"] == "bound"
+    d.submit("ns2", "filler", shared("1", "1"))   # fills the queue
+    d.submit("ns", "held", shared("1", "1"))      # replay: exempt
+    assert d.shed_total == 0
+    with pytest.raises(Overloaded) as exc:
+        d.submit("ns3", "fresh", shared("1", "1"))
+    assert exc.value.reason == "max-pending"
+    assert d.shed_total == 1

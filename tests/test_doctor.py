@@ -54,8 +54,8 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
                       "--scheduler", "127.0.0.1:1"])
     out = capsys.readouterr().out
     assert rc == 1
-    # registry + scheduler + autopilot + slo + leases all refuse
-    assert out.count("fail") == 5
+    # registry + scheduler + autopilot + serving + slo + leases all refuse
+    assert out.count("fail") == 6
 
 
 def test_doctor_cli_subprocess():
@@ -121,5 +121,38 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
                       "--scheduler", f"127.0.0.1:{ports[1]}"])
     out = capsys.readouterr().out
     assert rc == 1, out
-    # registry + scheduler + autopilot + slo + leases all refuse
-    assert out.count("fail") == 5, out
+    # registry + scheduler + autopilot + serving + slo + leases all refuse
+    assert out.count("fail") == 6, out
+
+
+def test_doctor_serving_probe_skip_then_ok(capsys, monkeypatch):
+    """The serving probe skips on a live scheduler with no front door
+    attached (the plane runs where the serving process does) and turns
+    ok — reporting tenants/queued/shed — once one is attached."""
+    import numpy as np
+    from kubeshare_tpu.serving import FrontDoor
+
+    monkeypatch.setenv("KUBESHARE_TPU_FAKE_TOPOLOGY", "1:2x2")
+    registry = TelemetryRegistry()
+    reg_srv = registry.serve()
+    svc = SchedulerService(SchedulerEngine(), registry, replay=False)
+    svc_srv = svc.serve()
+    args = ["--skip-chip",
+            "--registry", f"127.0.0.1:{reg_srv.server_address[1]}",
+            "--scheduler", f"127.0.0.1:{svc_srv.server_address[1]}"]
+    try:
+        assert doctor_main(args) == 0
+        out = capsys.readouterr().out
+        assert "no front door attached" in out
+
+        fd = FrontDoor(max_queue=8, clock=lambda: 100.0)
+        fd.register_tenant("api", tpu_class="latency")
+        fd.submit("api", np.ones((1, 4), dtype=np.float32))
+        svc.attach_serving(fd)
+        assert doctor_main(args) == 0
+        out = capsys.readouterr().out
+        assert "serving" in out and "1 tenant(s), 1 queued" in out
+    finally:
+        svc.close()
+        reg_srv.shutdown()
+        reg_srv.server_close()

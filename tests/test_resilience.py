@@ -384,3 +384,54 @@ def test_dispatcher_plans_migration_destination():
     others = [n for n in eng.nodes if n != src]
     assert disp.plan_migration(key, exclude=others) is None
     assert disp.plan_migration("ns/ghost") is None
+
+
+# -- latency-class round-trip (serving plane rides recovery verbatim) --------
+
+
+def test_latency_class_survives_journal_crash_recovery(tmp_path):
+    """A latency-class session (the serving plane's front-door tenants)
+    restores from the journal with its class intact: the restarted
+    scheduler re-registers the client as ``latency``, so priority
+    admission keeps holding after a crash — not just the buffers."""
+    p1 = ChipProxy(scheduler=TokenScheduler(WINDOW, BASE, MIN),
+                   journal_dir=str(tmp_path))
+    p1.serve()
+    c = connect(p1, "lat-crash", tpu_class="latency")
+    x = np.arange(64, dtype=np.float32)
+    bx = c.put(x)
+    assert p1._session("lat-crash").tpu_class == "latency"
+    p1.crash()
+
+    p2 = ChipProxy(scheduler=TokenScheduler(WINDOW, BASE, MIN),
+                   journal_dir=str(tmp_path))
+    p2.serve()
+    c.set_endpoint("127.0.0.1", p2.port)
+    np.testing.assert_array_equal(c.get(bx), x)
+    assert p2._session("lat-crash").tpu_class == "latency"
+    assert p2.scheduler._classes["lat-crash"] == "latency"
+    c.close()
+    p2.close()
+    p1.close()
+
+
+def test_latency_class_survives_live_migration():
+    """Live migration exports/imports the session manifest's ``class``
+    key: the destination session and its token scheduler both see
+    ``latency``, so a migrated serving tenant keeps its priority."""
+    p1 = make_proxy()
+    p2 = make_proxy()
+    try:
+        c = connect(p1, "lat-mover", tpu_class="latency")
+        x = np.arange(128, dtype=np.float32)
+        bx = c.put(x)
+        assert p1._session("lat-mover").tpu_class == "latency"
+        migrate_session(("127.0.0.1", p1.port), ("127.0.0.1", p2.port),
+                        c._conn.token, drain=True)
+        np.testing.assert_array_equal(c.get(bx), x)
+        assert p2._session("lat-mover").tpu_class == "latency"
+        assert p2.scheduler._classes["lat-mover"] == "latency"
+        c.close()
+    finally:
+        p1.close()
+        p2.close()

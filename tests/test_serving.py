@@ -1,0 +1,393 @@
+"""Serving plane: admission/shed, batching bounds, class priority,
+per-tenant accounting, park/resume (doc/serving.md).
+
+Everything here is deterministic: a manual clock drives the front door
+and batcher, the servable is an in-process numpy function, and the
+virtual-time simulation is seeded.
+"""
+
+import numpy as np
+import pytest
+
+from kubeshare_tpu.obs.metrics import MetricsRegistry
+from kubeshare_tpu.scheduler.dispatcher import Overloaded
+from kubeshare_tpu.serving import (ContinuousBatcher, FrontDoor,
+                                   LocalServable, ServingAccounting,
+                                   SessionParked, TokenBucket,
+                                   simulate_serving)
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def row(v, features=4):
+    return np.full((1, features), float(v), dtype=np.float32)
+
+
+@pytest.fixture
+def clock():
+    return Clock()
+
+
+def make_stack(clock, max_queue=16, batch=8, max_wait=0.01,
+               fn=lambda x: x * 2.0):
+    fd = FrontDoor(max_queue=max_queue, clock=clock,
+                   accounting=ServingAccounting(MetricsRegistry()))
+    batcher = ContinuousBatcher(fd, LocalServable(fn, batch),
+                                max_wait_s=max_wait, clock=clock)
+    return fd, batcher
+
+
+# -- admission ---------------------------------------------------------------
+
+
+def test_token_bucket_is_deterministic_under_explicit_clock():
+    b = TokenBucket(rate=2.0, burst=2.0)
+    assert b.try_take(0.0) and b.try_take(0.0)
+    assert not b.try_take(0.0)          # burst exhausted
+    assert not b.try_take(0.4)          # 0.8 tokens refilled — not enough
+    assert b.try_take(0.5)              # exactly 1.0 refilled
+    assert not b.try_take(0.5)
+
+
+def test_rate_limit_sheds_with_reason_and_accounts(clock):
+    fd, batcher = make_stack(clock)
+    fd.register_tenant("t", rate=2.0, burst=2.0)
+    fd.submit("t", row(1))
+    fd.submit("t", row(2))
+    with pytest.raises(Overloaded) as ei:
+        fd.submit("t", row(3))
+    assert ei.value.reason == "rate-limit"
+    assert fd.shed_total == 1 and fd.admitted_total == 2
+    assert fd.accounting.sheds.value("t", "rate-limit") == 1
+    clock.t += 1.0                      # refill; admitted again
+    fd.submit("t", row(4))
+    assert batcher.flush(clock.t) == 3
+
+
+def test_global_queue_bound_sheds_max_pending(clock):
+    fd, _ = make_stack(clock, max_queue=3)
+    for i in range(3):
+        fd.submit("solo", row(i))
+    with pytest.raises(Overloaded) as ei:
+        fd.submit("solo", row(9))
+    assert ei.value.reason == "max-pending"
+
+
+def test_fair_share_protects_second_tenant(clock):
+    fd, _ = make_stack(clock, max_queue=8)
+    # alone, a tenant may use the whole queue...
+    for i in range(6):
+        fd.submit("hog", row(i))
+    # ...but once a second tenant is active its share is 8//2 = 4,
+    # which "hog" already exceeds: hog sheds, the newcomer is admitted.
+    fd.submit("small", row(0))
+    with pytest.raises(Overloaded) as ei:
+        fd.submit("hog", row(9))
+    assert ei.value.reason == "fair-share"
+    fd.submit("small", row(1))          # under its share: still fine
+    assert fd.accounting.sheds.value("hog", "fair-share") == 1
+
+
+# -- batching bounds ---------------------------------------------------------
+
+
+def test_lone_request_ships_only_after_max_wait(clock):
+    fd, batcher = make_stack(clock, max_wait=0.01)
+    req = fd.submit("t", row(21))
+    assert batcher.step(clock.t) == 0            # too fresh, batch of 1
+    clock.t += 0.009
+    assert batcher.step(clock.t) == 0            # still inside max-wait
+    clock.t += 0.001
+    assert batcher.step(clock.t) == 1            # max-wait reached
+    np.testing.assert_allclose(req.result(0), row(21) * 2.0)
+    assert batcher.next_deadline() is None
+
+
+def test_full_batch_ships_immediately_and_respects_max_batch(clock):
+    fd, batcher = make_stack(clock, max_queue=32, batch=8)
+    reqs = [fd.submit("t", row(i)) for i in range(20)]
+    # 20 rows queued: ready without any wait, but each execution is
+    # capped at max_batch=8 rows.
+    assert batcher.ready(clock.t)
+    assert batcher.step(clock.t) == 8
+    assert batcher.step(clock.t) == 8
+    assert batcher.step(clock.t) == 0            # 4 left, too fresh
+    clock.t += 0.011
+    assert batcher.step(clock.t) == 4
+    for i, r in enumerate(reqs):
+        np.testing.assert_allclose(r.result(0), row(i) * 2.0)
+
+
+def test_batch_groups_only_compatible_signatures(clock):
+    fd, batcher = make_stack(clock, batch=8)
+    a = fd.submit("t", row(1, features=4))
+    b = fd.submit("t", np.ones((1, 6), dtype=np.float32))
+    clock.t += 0.02
+    assert batcher.step(clock.t) == 1            # only the (4,) head
+    assert a.done and not b.done
+    assert batcher.step(clock.t) == 1            # then the (6,) one
+    assert b.done
+
+
+def test_failed_execution_fails_riders_loudly_never_drops(clock):
+    def boom(x):
+        raise RuntimeError("backend gone")
+
+    fd, batcher = make_stack(clock, fn=boom)
+    reqs = [fd.submit("t", row(i)) for i in range(3)]
+    clock.t += 0.02
+    assert batcher.step(clock.t) == 3
+    for r in reqs:
+        with pytest.raises(RuntimeError, match="backend gone"):
+            r.result(0)
+    assert fd.failed_total == 3 and fd.completed_total == 0
+    assert fd.admitted_total == fd.completed_total + fd.failed_total
+    assert fd.accounting.requests.value("t", "best-effort", "failed") == 3
+
+
+# -- class priority ----------------------------------------------------------
+
+
+def test_latency_class_jumps_best_effort_queue(clock):
+    fd, batcher = make_stack(clock, max_queue=32, batch=4)
+    fd.register_tenant("lat", tpu_class="latency")
+    be = [fd.submit("be", row(i)) for i in range(6)]
+    clock.t += 0.001
+    hot = fd.submit("lat", row(99))              # submitted LAST
+    batch = fd.pop_batch(4)
+    assert batch[0] is hot                       # head of the batch
+    assert [r.tenant for r in batch].count("be") == 3
+
+
+def test_round_robin_across_same_class_tenants(clock):
+    fd, _ = make_stack(clock, max_queue=32, batch=4)
+    for i in range(4):
+        fd.submit("a", row(i))
+        clock.t += 1e-4
+        fd.submit("b", row(i))
+        clock.t += 1e-4
+    batch = fd.pop_batch(4)
+    assert sorted(r.tenant for r in batch) == ["a", "a", "b", "b"]
+
+
+# -- accounting --------------------------------------------------------------
+
+
+def test_accounting_per_tenant_class_tokens_bytes_and_exemplars(clock):
+    reg = MetricsRegistry()
+    fd = FrontDoor(max_queue=16, clock=clock,
+                   accounting=ServingAccounting(reg))
+    batcher = ContinuousBatcher(fd, LocalServable(lambda x: x, 8),
+                                max_wait_s=0.01, clock=clock)
+    fd.register_tenant("lat", tpu_class="latency")
+    fd.submit("lat", row(1), trace_id="trace-lat-1")
+    fd.submit("be", row(2), trace_id="trace-be-1")
+    clock.t += 0.02
+    assert batcher.step(clock.t) == 2
+    acct = fd.accounting
+    assert acct.requests.value("lat", "latency", "completed") == 1
+    assert acct.requests.value("be", "best-effort", "completed") == 1
+    assert acct.tokens.value("lat", "latency") == 1
+    assert acct.bytes.value("lat", "latency", "in") == row(1).nbytes
+    assert acct.bytes.value("lat", "latency", "out") == row(1).nbytes
+    assert acct.executions.value("lat", "latency") == 1
+    snap = acct.snapshot()
+    assert snap["tenants"]["lat"]["p99_ms"] > 0
+    assert snap["batches"] == 1 and snap["batch_rows"] == 2
+    # the latency histogram carries the submit-time trace id as an
+    # OpenMetrics exemplar on its bucket lines (PR 6 contract)
+    text = reg.render()
+    assert 'trace_id="trace-lat-1"' in text
+    assert "kubeshare_serving_request_latency_seconds_bucket" in text
+
+
+def test_state_joins_queues_totals_and_knobs(clock):
+    fd, batcher = make_stack(clock, max_queue=16)
+    fd.register_tenant("lat", tpu_class="latency")
+    fd.submit("lat", row(1))
+    state = fd.state()
+    assert state["attached"] is True
+    assert state["tenants"]["lat"]["queued"] == 1
+    assert state["totals"] == {"admitted": 1, "shed": 0, "completed": 0,
+                               "failed": 0, "queued": 1}
+    assert state["batcher"]["max_batch"] == 8
+    clock.t += 0.02
+    batcher.step(clock.t)
+    state = fd.state()
+    assert state["totals"]["completed"] == 1
+    assert state["tenants"]["lat"]["watermark"] == 1
+
+
+# -- park/resume -------------------------------------------------------------
+
+
+def test_park_resume_in_flight_tenant_session(clock):
+    fd, batcher = make_stack(clock, max_queue=32)
+    fd.register_tenant("s", tpu_class="latency", rate=100.0, burst=50.0)
+    first = [fd.submit("s", row(i)) for i in range(2)]
+    clock.t += 0.02
+    assert batcher.step(clock.t) == 2            # watermark -> 2
+    mid = [fd.submit("s", row(10 + i)) for i in range(3)]
+    manifest = fd.park("s")
+    assert manifest["class"] == "latency"
+    assert manifest["delivered"] == 2            # sequence watermark
+    assert manifest["next_rid"] == 5
+    assert len(manifest["pending"]) == 3
+    assert manifest["token"]
+    for r in mid:                                # old futures fail loudly
+        with pytest.raises(SessionParked):
+            r.result(0)
+    # resume into a FRESH front door (a restarted serving process)
+    fd2, batcher2 = make_stack(clock, max_queue=32)
+    restored = fd2.resume(manifest)
+    assert [r.rid for r in restored] == [2, 3, 4]
+    clock.t += 0.02
+    assert batcher2.step(clock.t) == 3
+    for i, r in enumerate(restored):             # payloads round-tripped
+        np.testing.assert_allclose(r.result(0), row(10 + i) * 2.0)
+    # exactly-once across the park: 2 before + 3 after, no replays
+    assert fd.completed_total + fd2.completed_total == 5
+    state = fd2.state()
+    assert state["tenants"]["s"]["watermark"] == 5
+    assert state["tenants"]["s"]["class"] == "latency"
+    # the sequence continues where the watermark left off
+    nxt = fd2.submit("s", row(42))
+    assert nxt.rid == 5
+    for r in first:
+        assert r.done                            # old results untouched
+
+
+def test_resume_refuses_active_tenant_and_park_unknown(clock):
+    fd, _ = make_stack(clock)
+    fd.register_tenant("t")
+    with pytest.raises(KeyError):
+        fd.park("ghost")
+    m = fd.park("t")
+    fd.resume(m)
+    with pytest.raises(ValueError, match="already active"):
+        fd.resume(m)
+
+
+# -- no admitted request dropped (seeded churn) ------------------------------
+
+
+def test_no_admitted_request_dropped_under_seeded_churn(clock):
+    import random
+
+    rng = random.Random(17)
+    fd, batcher = make_stack(clock, max_queue=12, batch=4)
+    fd.register_tenant("lat", tpu_class="latency")
+    admitted = []
+    parked_manifest = None
+    lat_parked = False
+    for i in range(300):
+        clock.t += rng.uniform(0.0005, 0.004)
+        tenant = rng.choice(["lat", "be-1", "be-2"])
+        if tenant == "lat" and lat_parked:
+            continue          # a parked tenant's client is detached
+        try:
+            admitted.append(fd.submit(tenant, row(i)))
+        except Overloaded:
+            pass
+        batcher.step(clock.t)
+        if i == 150:                             # park mid-churn...
+            parked_manifest = fd.park("lat")
+            lat_parked = True
+        if i == 200:                             # ...and resume later
+            admitted.extend(fd.resume(parked_manifest))
+            lat_parked = False
+    clock.t += 1.0
+    batcher.flush(clock.t)
+    parked = sum(1 for r in admitted
+                 if r.error is not None
+                 and isinstance(r.error, SessionParked))
+    done = sum(1 for r in admitted if r.done and r.error is None)
+    # every admitted request is accounted for: completed, or parked and
+    # then re-admitted via the manifest (which re-enters `admitted`)
+    assert done + parked == len(admitted)
+    assert fd.completed_total == done
+
+
+# -- virtual-time simulation -------------------------------------------------
+
+
+def test_simulate_serving_deterministic_and_sheds_past_saturation():
+    kw = dict(n_requests=400, tenants=4, qps=1600.0, seed=9,
+              latency_tenants=0, max_batch=8, exec_time_s=0.01,
+              max_queue=16)
+    a = simulate_serving(**kw)
+    b = simulate_serving(**kw)
+    assert a == b                                # bit-for-bit stats
+    assert a["shed"] > 0                         # 2x capacity: must shed
+    assert a["dropped"] == 0                     # but never drop
+    assert a["completed"] == a["admitted"]
+    assert a["isolation_error"] < 0.1
+
+
+def test_simulate_serving_latency_class_survives_flood():
+    out = simulate_serving(n_requests=800, tenants=4, qps=1600.0,
+                           seed=7, latency_tenants=1,
+                           exec_time_s=0.01, max_queue=24)
+    lat = out["tenants"]["tenant-0"]
+    be_p99 = max(rec["p99_ms"] for name, rec in out["tenants"].items()
+                 if rec["class"] == "best-effort")
+    assert lat["class"] == "latency"
+    assert lat["p99_ms"] < be_p99 / 2            # priority is visible
+    assert lat["p99_ms"] <= 50.0
+
+
+def test_simulate_serving_records_slo_samples():
+    from kubeshare_tpu.obs.slo import SloEvaluator, parse_slo
+
+    ev = SloEvaluator()
+    for i in range(2):
+        ev.declare(f"tenant-{i}", parse_slo("serve-p99<=50ms"))
+    out = simulate_serving(n_requests=200, tenants=2, qps=400.0,
+                           seed=3, exec_time_s=0.01, slo=ev,
+                           slo_every_s=0.5)
+    state = ev.state(now=out["duration_s"])
+    assert set(state["tenants"]) == {"tenant-0", "tenant-1"}
+    assert "slo_alerts" in out
+
+
+# -- service route + bridge --------------------------------------------------
+
+
+def test_serving_route_attached_and_detached(clock):
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.bridge import ServiceClient
+    from kubeshare_tpu.scheduler.service import SchedulerService
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    eng = SchedulerEngine()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=1, mesh=(2,)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    svc = SchedulerService(eng, TelemetryRegistry())
+    srv = svc.serve()
+    try:
+        client = ServiceClient(
+            f"http://127.0.0.1:{srv.server_address[1]}")
+        assert client.serving() == {"attached": False}
+        fd, batcher = make_stack(clock)
+        fd.register_tenant("lat", tpu_class="latency")
+        fd.submit("lat", row(1))
+        clock.t += 0.02
+        batcher.step(clock.t)
+        svc.attach_serving(fd)
+        body = client.serving()
+        assert body["attached"] is True
+        assert body["tenants"]["lat"]["completed"] == 1
+        assert body["totals"]["admitted"] == 1
+        assert body["batcher"]["max_batch"] == 8
+    finally:
+        svc.close()

@@ -130,3 +130,79 @@ def test_cli_annotates_outstanding_evictions(capsys):
     finally:
         svc.close()
         rsrv.shutdown()
+
+
+def test_cli_serving_view_joins_front_door(capsys):
+    """--serving renders the scheduler's /serving join: totals, knobs,
+    and one row per tenant with class + latency quantiles; a scheduler
+    with no front door attached says so instead of a table."""
+    import numpy as np
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.service import SchedulerService
+    from kubeshare_tpu.serving import (ContinuousBatcher, FrontDoor,
+                                       LocalServable)
+
+    reg, srv, _ = serve_fleet()
+    svc = SchedulerService(SchedulerEngine(), reg, replay=False)
+    svc.serve()
+    rport = srv.server_address[1]
+    addr = f"127.0.0.1:{rport}"
+    sched = f"127.0.0.1:{svc.port}"
+    try:
+        # not attached yet: the view degrades loudly, exit still 0
+        assert topcli.main(["--registry", addr, "--scheduler", sched,
+                            "--serving"]) == 0
+        out = capsys.readouterr().out
+        assert "SERVING" in out and "not attached" in out
+
+        t = [100.0]
+        fd = FrontDoor(max_queue=16, clock=lambda: t[0])
+        batcher = ContinuousBatcher(
+            fd, LocalServable(lambda x: x * 2.0, batch_size=8),
+            max_wait_s=0.01, clock=lambda: t[0])
+        fd.register_tenant("api", tpu_class="latency")
+        fd.register_tenant("bulk")
+        row = np.ones((1, 4), dtype=np.float32)
+        for _ in range(3):
+            fd.submit("api", row)
+        fd.submit("bulk", row)
+        t[0] += 0.02
+        batcher.step(now=t[0])            # all 4 complete in one batch
+        fd.submit("bulk", row)            # one left queued
+        svc.attach_serving(fd)
+
+        assert topcli.main(["--registry", addr, "--scheduler", sched,
+                            "--serving"]) == 0
+        out = capsys.readouterr().out
+        assert "5 admitted / 0 shed / 4 completed" in out
+        assert "queued 1" in out and "over 4 chip(s)" in out
+        assert "max_batch 8" in out
+        api = next(l for l in out.splitlines() if l.strip().startswith("api"))
+        assert "latency" in api
+        bulk = next(l for l in out.splitlines()
+                    if l.strip().startswith("bulk"))
+        assert "best-effort" in bulk
+
+        assert topcli.main(["--registry", addr, "--scheduler", sched,
+                            "--serving", "--json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["serving"]["attached"] is True
+        assert snap["serving"]["tenants"]["api"]["class"] == "latency"
+        assert snap["serving"]["totals"]["queued"] == 1
+        assert snap["chips"] == 4
+    finally:
+        svc.close()
+        srv.shutdown()
+
+
+def test_cli_serving_unreachable_scheduler_degrades(capsys):
+    reg, srv, _ = serve_fleet()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        assert topcli.main(["--registry", addr, "--scheduler",
+                            "127.0.0.1:1", "--serving"]) == 0
+        captured = capsys.readouterr()
+        assert "not attached" in captured.out
+        assert "scheduler unreachable" in captured.err
+    finally:
+        srv.shutdown()
