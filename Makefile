@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet image clean obs-check
 
 all: native
 
@@ -31,13 +31,15 @@ test-slow:
 	$(PY) -m pytest tests/ -x -q -m slow
 
 # Observability plane gate: exposition-format lint (incl. exemplar
-# syntax round-trip), trace-propagation + SLO/burn-rate tests, the
-# self-validating 3-pod smoke, then a flight-recorder smoke — a sim
-# replay with an injected slow tenant must dump a parseable JSONL
-# black box (doc/observability.md).
+# syntax round-trip), trace-propagation + SLO/burn-rate + TSDB/critpath
+# tests, the self-validating 3-pod smoke, a flight-recorder smoke — a
+# sim replay with an injected slow tenant must dump a parseable JSONL
+# black box — and the fleet smoke: remote-write from three pushers,
+# one GET /query per aggregation, critical-path assembly across >= 3
+# processes (doc/observability.md).
 obs-check:
 	$(PY) -m pytest tests/test_obs.py tests/test_trace_propagation.py \
-		tests/test_slo.py -x -q
+		tests/test_slo.py tests/test_tsdb.py tests/test_critpath.py -x -q
 	$(PY) scripts/trace_demo.py
 	JAX_PLATFORMS=cpu $(PY) -m kubeshare_tpu.sim.simulator --synthetic 300 \
 		--slo 'queue-wait-p99<=500ms,availability>=99' \
@@ -47,6 +49,7 @@ obs-check:
 		d = parse_dump_jsonl(open('/tmp/kubeshare-flight-smoke.jsonl').read()); \
 		assert d['entries'], 'empty flight dump'; \
 		print('flight dump ok: %d entries' % len(d['entries']))"
+	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py
 
 bench:
 	$(PY) bench.py
@@ -94,6 +97,15 @@ bench-slo:
 bench-serving:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_serving.py --check \
 		--baseline bench_serving.json --write bench_serving.json
+
+# Fleet telemetry bench (doc/observability.md): server-side remote-write
+# ingest cost at 1k samples/push, GET /query latency over 16 instances
+# x 10 min retention, and critical-path coverage on the sim's
+# deterministic traces; --check gates the <1ms ingest, <10ms query p50
+# and >=95% coverage bars, then refreshes bench_fleet.json.
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_fleet.py --check \
+		--baseline bench_fleet.json --write bench_fleet.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

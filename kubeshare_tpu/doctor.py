@@ -16,7 +16,12 @@ installing the next (Prometheus endpoints, the ``gpu_capacity`` metric —
    health plane's wire, ``doc/health.md``)?
 7. **heartbeat** — is THIS node's lease fresh (age < its TTL)? A deployed
    agent whose beats aren't landing is exactly a silent future eviction.
-8. **clockskew** — |local clock − registry clock| < TTL/4. Lease ages are
+8. **fleetquery / pushfresh** — does the registry's ``GET /query``
+   evaluate a fleet aggregation, and is every remote-writing instance's
+   newest sample younger than two push intervals
+   (``doc/observability.md``)? Lag is a *warn*: the TSDB stales the
+   instance on its own.
+9. **clockskew** — |local clock − registry clock| < TTL/4. Lease ages are
    computed on the registry's clock, so the health plane itself tolerates
    any skew — but a drifting node corrupts every *other* cross-host
    timestamp (capacity ages, trace spans), and TTL/4 is where an operator
@@ -287,6 +292,70 @@ def check_slo(addr: str, timeout_s: float,
         f"{rec.get('dropped', 0)} dropped") and ok
 
 
+def check_fleet(addr: str, timeout_s: float,
+                defaulted: bool = False) -> bool:
+    """Telemetry-plane probes (doc/observability.md): ``/query`` must
+    evaluate a fleet aggregation registry-side, and every live pushing
+    instance must be fresh — a newest sample older than two push
+    intervals means that process's remote-writer is wedged. Freshness
+    lag is a *warn* (passing): the TSDB marks the instance stale on
+    its own at ``stale_after_s``, and already-stale instances are
+    visibly retired rather than re-flagged here."""
+    if not addr or addr == "none":
+        _result("fleetquery", "skip", "--registry none")
+        _result("pushfresh", "skip", "--registry none")
+        return True
+    from .telemetry.registry import RegistryClient
+    from .telemetry.remote_write import DEFAULT_PUSH_PERIOD_S
+    host, _, port = addr.partition(":")
+    client = RegistryClient(host, int(port), timeout=timeout_s)
+    try:
+        res = client.query("kubeshare_remote_write_pushes_total",
+                           agg="increase", window_s=60.0)
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            _result("fleetquery", "skip",
+                    f"{addr} refused (no cluster on this host)")
+            _result("pushfresh", "skip", "no registry")
+            return True
+        if "404" in str(exc):
+            _result("fleetquery", "skip", "registry predates /query")
+            _result("pushfresh", "skip", "registry predates /instances")
+            return True
+        _result("pushfresh", "skip", "/query unreachable")
+        return _result("fleetquery", "fail", f"{addr}: {exc}")
+    ok = _result("fleetquery", "ok",
+                 f"{addr}: {res.get('series_matched', 0)} series matched, "
+                 f"{len(res.get('groups', []))} group(s)")
+    try:
+        inst = client.instances()
+    except Exception as exc:
+        return _result("pushfresh", "fail", f"{addr}: {exc}") and ok
+    instances = inst.get("instances", [])
+    if not instances:
+        _result("pushfresh", "skip",
+                "no instance has remote-written yet (scheduler pushes "
+                "by default; chipproxy --remote-write; launcherd "
+                "--registry-host)")
+        return ok
+    limit = 2.0 * DEFAULT_PUSH_PERIOD_S
+    lagging = [i for i in instances
+               if not i.get("stale") and i.get("age_s", 0.0) > limit]
+    retired = sum(1 for i in instances if i.get("stale"))
+    if lagging:
+        worst = max(lagging, key=lambda i: i.get("age_s", 0.0))
+        return _result(
+            "pushfresh", "warn",
+            f"{len(lagging)} instance(s) past {limit:.0f}s (2 push "
+            f"intervals); worst {worst['instance']} at "
+            f"{worst['age_s']:.1f}s — remote-writer wedged?") and ok
+    return _result(
+        "pushfresh", "ok",
+        f"{len(instances) - retired} instance(s) fresh (< {limit:.0f}s)"
+        + (f", {retired} stale/retired" if retired else "")) and ok
+
+
 def check_leases(addr: str, timeout_s: float, node: str,
                  defaulted: bool = False) -> bool:
     """Three health-plane probes against one ``/leases`` read: endpoint
@@ -411,6 +480,7 @@ def main(argv=None) -> int:
         ok &= chip_ok
     ok &= check_discovery(chip_ok, args.chip_timeout)
     ok &= check_registry(registry, 5.0, defaulted=reg_defaulted)
+    ok &= check_fleet(registry, 5.0, defaulted=reg_defaulted)
     ok &= check_scheduler(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_autopilot(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_serving(scheduler, 5.0, defaulted=sched_defaulted)

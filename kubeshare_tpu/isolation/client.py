@@ -27,6 +27,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..utils.logger import get_logger
 from . import protocol
 from .protocol import load_array
@@ -591,11 +592,27 @@ class ProxyClient:
             msg["donate"] = list(donate)
         if repeat != 1:
             msg["repeat"] = repeat
+        tid = getattr(self._conn, "trace_id", "")
+        tracer = obs_trace.get_tracer() if tid else None
+        t0 = tracer.now_ms() if tracer is not None else 0.0
         if self._conn.pipelined:
             rep = self._conn.submit(msg, defer=defer)
-            return RemoteFuture(lambda: list(rep.result()[0]["handles"]),
-                                rep)
+
+            def resolve():
+                handles_out = list(rep.result()[0]["handles"])
+                if tracer is not None:
+                    # client-measured round trip: the critical-path
+                    # "transport" segment (the proxy's own "execute"
+                    # span is subtracted in obs/critpath.py)
+                    tracer.record("transport", tid, t0, tracer.now_ms(),
+                                  proc="client", op="execute")
+                return handles_out
+
+            return RemoteFuture(resolve, rep)
         reply, _ = self._conn.call(msg)   # lockstep: resolved already
+        if tracer is not None:
+            tracer.record("transport", tid, t0, tracer.now_ms(),
+                          proc="client", op="execute")
         return RemoteFuture(lambda: list(reply["handles"]))
 
     def flush(self) -> None:
@@ -623,10 +640,25 @@ class ProxyClient:
             n = int(reply.get("repeat", repeat))
             return list(reply["handles"]), n, int(reply.get("burst", n))
 
+        tid = getattr(self._conn, "trace_id", "")
+        tracer = obs_trace.get_tracer() if tid else None
+        t0 = tracer.now_ms() if tracer is not None else 0.0
+
         if self._conn.pipelined:
             rep = self._conn.submit(msg)
-            return RemoteFuture(lambda: unwrap(rep.result()[0]), rep)
+
+            def resolve():
+                out = unwrap(rep.result()[0])
+                if tracer is not None:
+                    tracer.record("transport", tid, t0, tracer.now_ms(),
+                                  proc="client", op="execute")
+                return out
+
+            return RemoteFuture(resolve, rep)
         reply, _ = self._conn.call(msg)   # lockstep: resolved already
+        if tracer is not None:
+            tracer.record("transport", tid, t0, tracer.now_ms(),
+                          proc="client", op="execute")
         return RemoteFuture(lambda: unwrap(reply))
 
     def usage(self) -> dict:

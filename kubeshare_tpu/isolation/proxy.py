@@ -36,6 +36,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..obs.flight import default_recorder as flight_default_recorder
 from ..resilience import faults as _faults
 from ..resilience.journal import SessionJournal
@@ -734,6 +735,19 @@ class ChipProxy:
             # mint unbounded series
             _RPC_LAT.observe(op if op in _KNOWN_OPS else "other",
                              value=time.perf_counter() - t0)
+            if op == "execute":
+                # the critical-path "execute" segment: server-side
+                # service time under the pod's trace, so topcli
+                # --critpath can split the client's RPC round-trip into
+                # transport vs on-chip work (obs/critpath.py)
+                tid = state.get("trace_id", "")
+                if tid:
+                    tracer = obs_trace.get_tracer()
+                    end_ms = tracer.now_ms()
+                    tracer.record(
+                        "execute", tid,
+                        end_ms - (time.perf_counter() - t0) * 1000.0,
+                        end_ms, proc="chipproxy")
 
     def _handle(self, req: dict, state: dict) -> dict:
         op = req.get("op")
@@ -1696,6 +1710,15 @@ def main(argv=None) -> None:
                         default=os.environ.get("KUBESHARE_JOURNAL_DIR", ""),
                         help="directory for the durable session journal; "
                              "empty disables on-disk durability")
+    parser.add_argument("--remote-write", default="",
+                        help="HOST:PORT of the telemetry registry; when "
+                             "set, this proxy pushes its metric snapshot "
+                             "to the fleet TSDB every --push-period "
+                             "seconds (topcli --fleet)")
+    parser.add_argument("--push-period", type=float, default=5.0)
+    parser.add_argument("--instance", default="",
+                        help="instance label for remote-write (default "
+                             "node:port)")
     args = parser.parse_args(argv)
 
     if args.platform:
@@ -1716,11 +1739,22 @@ def main(argv=None) -> None:
     if args.token_port >= 0:
         token_server = serve_tokens(sched, args.host, args.token_port)
         token_port = f" TOKENS {token_server.server_address[1]}"
+    writer = None
+    if args.remote_write:
+        from ..telemetry.registry import RegistryClient
+        from ..telemetry.remote_write import RemoteWriter, default_instance
+        rw_host, _, rw_port = args.remote_write.rpartition(":")
+        writer = RemoteWriter(
+            RegistryClient(rw_host or "127.0.0.1", int(rw_port)),
+            args.instance or default_instance(server.server_address[1]),
+            "chipproxy", period_s=args.push_period).start()
     print(f"READY {server.server_address[1]}{token_port}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if writer is not None:
+        writer.stop()
     if token_server is not None:
         token_server.shutdown()
         token_server.server_close()

@@ -230,6 +230,9 @@ def main(argv=None) -> None:
     parser.add_argument("--registry-port", type=int,
                         default=C.REGISTRY_PORT)
     parser.add_argument("--lease-ttl", type=float, default=C.LEASE_TTL_S)
+    parser.add_argument("--push-period", type=float, default=5.0,
+                        help="remote-write period for this node agent's "
+                             "metric snapshot (doc/observability.md)")
     args = parser.parse_args(argv)
 
     chips = discover_chips(args.backend, host=args.node)
@@ -237,19 +240,27 @@ def main(argv=None) -> None:
                             base_dir=args.base_dir, poll_s=args.poll)
     daemon.start()
     heartbeat = None
+    writer = None
     if args.registry_host:
         # the launcher IS the node's liveness: if this process dies, the
         # lease stops renewing and the healthwatch evicts the node
         from ..telemetry.heartbeat import Heartbeater
         from ..telemetry.registry import RegistryClient
+        from ..telemetry.remote_write import RemoteWriter
         registry = RegistryClient(args.registry_host, args.registry_port)
         heartbeat = Heartbeater(registry, args.node,
                                 ttl_s=args.lease_ttl).start()
+        # ...and its metric snapshot joins the fleet TSDB so topcli
+        # --fleet sees the node agent next to proxies and the scheduler
+        writer = RemoteWriter(registry, args.node, "launcherd",
+                              period_s=args.push_period).start()
     print("READY", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if writer is not None:
+        writer.stop()
     if heartbeat is not None:
         heartbeat.stop()
     daemon.stop()

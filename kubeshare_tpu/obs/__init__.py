@@ -26,27 +26,43 @@ Four quarters:
 - :mod:`.flight` — the always-on flight recorder: a bounded ring of
   recent spans/notes/alerts/metric deltas, dumped as a JSONL black box
   when a trigger (alert, eviction, rollback, crash) fires.
+- :mod:`.tsdb` — the fleet half: a bounded in-memory time-series store
+  fed by remote-write pushes (``telemetry/remote_write.py``), with
+  counter-reset-aware ``rate()``, staleness markers, and downsampled
+  retention tiers; hosted behind the telemetry registry's ``GET /query``.
+- :mod:`.critpath` — cross-process trace assembly: merges spans sharing
+  a trace ID from many sources and attributes a request's wall time to
+  named segments (admission → queue-wait → schedule → grant-wait →
+  transport → execute) for ``topcli --critpath``.
 
 See ``doc/observability.md`` for the full metric/span catalogue.
 """
 
+from .critpath import (SEGMENTS, assemble, load_spans, render_report,
+                       report, spans_from_flight_entries)
 from .flight import (FlightRecorder, default_recorder, dump_jsonl,
                      install_crash_handler, parse_dump_jsonl)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      default_registry, lint_exposition, parse_exposition,
-                      prom_escape, quantile_from_buckets, render_default,
-                      render_exposition, render_help_type, render_sample)
+                      collect_default, default_registry, lint_exposition,
+                      parse_exposition, prom_escape, quantile_from_buckets,
+                      render_default, render_exposition, render_help_type,
+                      render_sample)
 from .slo import (AlertEvent, SloError, SloEvaluator, SloSpec,
                   default_evaluator, parse_slo, set_default_evaluator)
 from .trace import (Span, Tracer, add_span_sink, get_tracer, install_tracer,
                     new_trace_id, remove_span_sink, tracing_enabled,
                     uninstall_tracer)
+from .tsdb import TimeSeriesStore
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "default_registry", "lint_exposition", "parse_exposition",
-    "prom_escape", "quantile_from_buckets", "render_default",
-    "render_exposition", "render_help_type", "render_sample",
+    "collect_default", "default_registry", "lint_exposition",
+    "parse_exposition", "prom_escape", "quantile_from_buckets",
+    "render_default", "render_exposition", "render_help_type",
+    "render_sample",
+    "TimeSeriesStore",
+    "SEGMENTS", "assemble", "load_spans", "render_report", "report",
+    "spans_from_flight_entries",
     "Span", "Tracer", "add_span_sink", "get_tracer", "install_tracer",
     "new_trace_id", "remove_span_sink", "tracing_enabled",
     "uninstall_tracer",

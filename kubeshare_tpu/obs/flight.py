@@ -39,6 +39,9 @@ from .trace import Span, add_span_sink
 
 DEFAULT_CAPACITY = 2048
 MAX_RETAINED_DUMPS = 8
+#: on-disk retention under ``dump_dir`` — unlike the in-memory deque,
+#: files used to accumulate forever; pruned oldest-mtime-first past this
+MAX_DUMP_FILES = 32
 
 
 class FlightRecorder:
@@ -46,11 +49,13 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
                  clock: Optional[Callable[[], float]] = None,
-                 dump_dir: Optional[str] = None):
+                 dump_dir: Optional[str] = None,
+                 max_dump_files: int = MAX_DUMP_FILES):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
         self._clock = clock or time.time
         self._dump_dir = dump_dir
+        self._max_dump_files = max(1, int(max_dump_files))
         self._dumps: deque = deque(maxlen=MAX_RETAINED_DUMPS)
         self._seq = 0
         self._dropped = 0
@@ -66,6 +71,10 @@ class FlightRecorder:
 
     def set_dump_dir(self, path: Optional[str]) -> None:
         self._dump_dir = path
+
+    def set_dump_retention(self, max_files: int) -> None:
+        """Cap on persisted ``flight-*.jsonl`` files (oldest pruned)."""
+        self._max_dump_files = max(1, int(max_files))
 
     # -- recording -----------------------------------------------------------
 
@@ -143,9 +152,35 @@ class FlightRecorder:
                 with open(path, "w") as fh:
                     fh.write(dump_jsonl(dump))
                 dump["path"] = path
+                self._prune_dump_files()
             except OSError:
                 pass          # the in-memory dump is still authoritative
         return dump
+
+    def _prune_dump_files(self) -> None:
+        """Keep at most ``max_dump_files`` dumps on disk, oldest-mtime
+        first. The sequence number restarts with the process, so mtime
+        — not the filename — is the age that matters across restarts."""
+        try:
+            names = [n for n in os.listdir(self._dump_dir)
+                     if n.startswith("flight-") and n.endswith(".jsonl")]
+        except OSError:
+            return
+        if len(names) <= self._max_dump_files:
+            return
+        paths = []
+        for n in names:
+            p = os.path.join(self._dump_dir, n)
+            try:
+                paths.append((os.path.getmtime(p), p))
+            except OSError:
+                continue
+        paths.sort()
+        for _, p in paths[:max(0, len(paths) - self._max_dump_files)]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
     def dumps(self) -> List[dict]:
         with self._lock:

@@ -237,9 +237,18 @@ class Dispatcher:
         :class:`Overloaded` when the bounded admission queue refuses new
         load. Returns the pod key (poll with :meth:`status` /
         :meth:`outcome`)."""
+        tracer = get_tracer()
+        adm_t0 = tracer.now_ms()
         with self._cond:
             self._check_admission(namespace, name)
             pod = self.engine.submit(namespace, name, labels, uid=uid)
+            # the critical path's first segment: admission control +
+            # label parse + enqueue, under the pod's fresh trace id
+            tracer.record("admission", pod.trace_id, adm_t0,
+                          tracer.now_ms(),
+                          parent_id=(pod.trace_span.span_id
+                                     if pod.trace_span else ""),
+                          pod=pod.key)
             parked = self._parked.get(pod.key)
             if parked is not None:
                 if parked.pod is pod:

@@ -89,6 +89,25 @@ class SchedulerService:
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
         self.serving = None
+        self.remote_write = None
+
+    def start_remote_write(self, instance: str | None = None,
+                           job: str = "scheduler",
+                           period_s: float | None = None):
+        """Begin pushing this service's full exposition (scheduler
+        gauges + process obs registry) to the registry's fleet TSDB.
+        Works against both a ``RegistryClient`` and an in-process
+        ``TelemetryRegistry`` (tests, sim)."""
+        from ..telemetry.remote_write import (DEFAULT_PUSH_PERIOD_S,
+                                              RemoteWriter)
+        if instance is None:
+            instance = (f"127.0.0.1:{self.port}" if self._server is not None
+                        else "scheduler")
+        self.remote_write = RemoteWriter(
+            self.registry, instance, job,
+            period_s=period_s or DEFAULT_PUSH_PERIOD_S,
+            collect=self.render_metrics).start()
+        return self.remote_write
 
     def attach_autopilot(self, autopilot) -> "SchedulerService":
         """Wire an :class:`~..autopilot.Autopilot` built over
@@ -345,6 +364,9 @@ class SchedulerService:
         return self._server.server_address[1]
 
     def close(self) -> None:
+        if self.remote_write is not None:
+            self.remote_write.stop()
+            self.remote_write = None
         self.dispatcher.stop()
         if self._server is not None:
             self._server.shutdown()
@@ -393,10 +415,20 @@ def main(argv=None) -> None:
     parser.add_argument("--flight-dump-dir", default="",
                         help="persist flight-recorder black-box dumps as "
                              "JSONL files here (in-memory only when empty)")
+    parser.add_argument("--flight-dump-cap", type=int,
+                        default=obs_flight.MAX_DUMP_FILES,
+                        help="max flight-*.jsonl files kept under "
+                             "--flight-dump-dir (oldest pruned by mtime)")
+    parser.add_argument("--no-remote-write", action="store_true",
+                        help="do not push this process's metrics to the "
+                             "registry fleet TSDB")
+    parser.add_argument("--push-period", type=float, default=5.0,
+                        help="remote-write push period in seconds")
     args = parser.parse_args(argv)
 
     if args.flight_dump_dir:
         obs_flight.default_recorder().set_dump_dir(args.flight_dump_dir)
+        obs_flight.default_recorder().set_dump_retention(args.flight_dump_cap)
     # an unhandled exception dumps the black box before the process dies
     obs_flight.install_crash_handler()
 
@@ -418,6 +450,8 @@ def main(argv=None) -> None:
                                   journal_path=(args.autopilot_journal
                                                 or None))))
     svc.serve(args.host, args.port)
+    if not args.no_remote_write:
+        svc.start_remote_write(period_s=args.push_period)
     watcher = ConfigWatcher(args.config).start() if args.config else None
     print("READY", flush=True)
     stop = threading.Event()

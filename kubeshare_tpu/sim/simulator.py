@@ -88,6 +88,91 @@ def churn_labels(job: TraceJob, rng: random.Random) -> dict:
     return {C.POD_TPU_REQUEST: str(request), C.POD_TPU_LIMIT: "1.0"}
 
 
+#: synthetic per-process tracer epochs for --critpath, in ms. Deliberately
+#: huge and distinct: real processes' monotonic epochs are incomparable,
+#: and the critpath assembler must attribute by durations alone — a run
+#: that accidentally depends on cross-source timestamp alignment would
+#: produce garbage coverage here and fail the bench gate.
+_CRITPATH_SOURCES = ("frontdoor", "scheduler", "chipproxy", "client")
+
+
+def simulate_critpath(n_requests: int, seed: int = 0,
+                      spans_dir: str | None = None) -> dict:
+    """Deterministic virtual-time span emission for the critical-path
+    assembler (doc/observability.md).
+
+    Synthesizes ``n_requests`` traced submit→reply journeys across four
+    synthetic processes — front door (admission), scheduler (root
+    ``submit``, queue-wait, filter/reserve/bind), chip proxy
+    (token-grant, execute), client (transport RTT enveloping execute) —
+    each process recording on its own :class:`~..obs.trace.Tracer` with
+    its own (wildly different) epoch. The residual the generator leaves
+    unattributed is bounded at 2% of wall, so assembled coverage must
+    come out ≥ 0.95; the bench gates on exactly that.
+
+    With ``spans_dir``, each source exports its spans to
+    ``<spans_dir>/<source>.jsonl`` — the files ``topcli --critpath
+    --spans`` consumes. Returns ``{"report": ..., "traces": [...]}``.
+    """
+    import os
+
+    from ..obs import critpath
+    from ..obs.trace import Tracer
+
+    rng = random.Random(seed)
+    tracers = {src: Tracer() for src in _CRITPATH_SOURCES}
+    epochs = {src: rng.uniform(1e6, 9e6) for src in _CRITPATH_SOURCES}
+
+    def rec(src, name, tid, start, end, parent_id=""):
+        off = epochs[src]
+        tracers[src].record(name, tid, start + off, end + off,
+                            parent_id=parent_id, proc=src)
+
+    t0 = 0.0
+    for i in range(n_requests):
+        tid = f"simtrace-{seed}-{i:04d}"
+        t = t0
+        a = rng.uniform(0.5, 2.0)          # admission
+        rec("frontdoor", "admission", tid, t, t + a)
+        t += a
+        q = rng.uniform(1.0, 40.0)         # queue wait
+        rec("scheduler", "queue-wait", tid, t, t + q)
+        t += q
+        f = rng.uniform(0.2, 1.0)
+        r = rng.uniform(0.1, 0.5)
+        b = rng.uniform(0.2, 1.0)
+        rec("scheduler", "filter", tid, t, t + f)
+        rec("scheduler", "reserve", tid, t + f, t + f + r)
+        rec("scheduler", "bind", tid, t + f + r, t + f + r + b)
+        t += f + r + b
+        g = rng.uniform(0.5, 5.0)          # token grant wait
+        rec("chipproxy", "token-grant", tid, t, t + g)
+        t += g
+        o1 = rng.uniform(0.2, 1.0)         # client->proxy wire time
+        e = rng.uniform(5.0, 50.0)         # proxy-side execute
+        o2 = rng.uniform(0.2, 1.0)         # proxy->client wire time
+        rec("client", "transport", tid, t, t + o1 + e + o2)
+        rec("chipproxy", "execute", tid, t + o1, t + o1 + e)
+        t += o1 + e + o2
+        # the generator's honesty margin: up to 2% of the journey is
+        # time no instrumented segment claims
+        resid = rng.uniform(0.0, 0.02) * (t - t0)
+        t_end = t + resid
+        rec("scheduler", "submit", tid, t0, t_end)
+        t0 = t_end + rng.uniform(0.0, 5.0)
+
+    rows = []
+    if spans_dir:
+        os.makedirs(spans_dir, exist_ok=True)
+    for src, tr in tracers.items():
+        if spans_dir:
+            tr.export_jsonl(os.path.join(spans_dir, f"{src}.jsonl"))
+        rows.extend(dict(s.to_dict(), kind="span") for s in tr.spans())
+    spans = critpath.spans_from_flight_entries(rows, source="sim")
+    traces = critpath.assemble(spans)
+    return {"report": critpath.report(traces), "traces": traces}
+
+
 @dataclass
 class SimStats:
     submitted: int = 0
@@ -499,12 +584,31 @@ def main(argv=None) -> None:
                         help="after the run, trigger a flight-recorder "
                              "dump and write it to PATH as JSONL "
                              "(doc/observability.md dump format)")
+    parser.add_argument("--critpath", type=int, default=0, metavar="N",
+                        help="emit N deterministic virtual-time traced "
+                             "requests across four synthetic processes, "
+                             "assemble them (obs/critpath.py) and print "
+                             "the machine-readable report — the "
+                             "coverage gate's workload (doc/"
+                             "observability.md)")
+    parser.add_argument("--spans-dir", default="", metavar="DIR",
+                        help="with --critpath: also export each "
+                             "synthetic process's spans to DIR/<source>"
+                             ".jsonl for topcli --critpath --spans")
     args = parser.parse_args(argv)
 
     if sum(map(bool, (args.synthetic, args.trace, args.churn,
-                      args.serve))) != 1:
+                      args.serve, args.critpath))) != 1:
         parser.error("exactly one of --trace / --synthetic / --churn "
-                     "/ --serve is required")
+                     "/ --serve / --critpath is required")
+    if args.critpath:
+        if args.spans_dir:
+            import os
+            os.makedirs(args.spans_dir, exist_ok=True)
+        out = simulate_critpath(args.critpath, seed=args.seed,
+                                spans_dir=args.spans_dir or None)
+        print(json.dumps({"critpath": out["report"]}))
+        return
     if args.serve:
         from ..obs import flight as obs_flight
         from ..serving import simulate_serving

@@ -11,9 +11,11 @@ from .aggregator import (publish_binding, requirement_record,
 from .collector import CapacityCollector
 from .heartbeat import Heartbeater
 from .registry import RegistryClient, TelemetryRegistry
+from .remote_write import RemoteWriter, default_instance
 
 __all__ = [
     "CapacityCollector", "Heartbeater", "RegistryClient",
-    "TelemetryRegistry", "publish_binding", "requirement_record",
+    "RemoteWriter", "TelemetryRegistry", "default_instance",
+    "publish_binding", "requirement_record",
     "sync_engine_from_registry", "withdraw",
 ]

@@ -61,6 +61,7 @@ from ..obs import metrics as obs_metrics
 # that predate the obs package (collector.py, external tools).
 from ..obs.metrics import prom_escape as _prom_escape  # noqa: F401
 from ..obs.metrics import render_help_type, render_sample as render_metric
+from ..obs.tsdb import TimeSeriesStore
 from ..utils.logger import get_logger
 
 log = get_logger("registry")
@@ -75,9 +76,17 @@ class TelemetryRegistry:
     """In-memory cluster state with an HTTP surface."""
 
     def __init__(self, journal: str | os.PathLike | None = None,
-                 compact_every: int = 1000, clock=time.time):
+                 compact_every: int = 1000, clock=time.time,
+                 tsdb: TimeSeriesStore | None = None):
         self._lock = threading.Lock()
         self._clock = clock
+        #: fleet TSDB behind POST /push + GET /query. Deliberately NOT
+        #: journaled: decision state (capacity/pods/leases) must survive
+        #: a restart, remote-written samples must NOT — replaying them
+        #: would resurrect instances that died while the registry was
+        #: down as fresh-looking series. Instances re-appear within one
+        #: push period; history restarts from zero.
+        self.tsdb = tsdb if tsdb is not None else TimeSeriesStore(clock=clock)
         self._capacity: dict[str, dict] = {}
         self._pods: dict[str, dict] = {}
         #: node -> {"epoch", "ttl_s", "ts"}; ts is ALWAYS this registry's
@@ -282,6 +291,23 @@ class TelemetryRegistry:
             self._leases.pop(node, None)
             self._log({"op": "drop_lease", "node": node})
 
+    # -- fleet TSDB (remote-write + query) ---------------------------------
+
+    def push_metrics(self, instance: str, job: str,
+                     snapshot: dict | None = None,
+                     exposition: str | None = None,
+                     now: float | None = None) -> int:
+        """Ingest one remote-write push; returns samples stored."""
+        return self.tsdb.ingest(instance, job, snapshot=snapshot,
+                                exposition=exposition, now=now)
+
+    def mark_instance_stale(self, instance: str) -> None:
+        self.tsdb.mark_stale(instance)
+
+    #: duck-type parity with RegistryClient so a RemoteWriter can push
+    #: into an in-process registry in tests and the sim
+    mark_stale = mark_instance_stale
+
     def render_metrics(self) -> str:
         """Prometheus exposition, reference metric shapes
         (collector.go:30-35, aggregator.go:22-39) under TPU names, plus
@@ -357,9 +383,58 @@ class TelemetryRegistry:
                 if path == "/metrics":
                     return self._reply(200, registry.render_metrics().encode(),
                                        "text/plain; version=0.0.4")
+                if path == "/query":
+                    return self._query()
+                if path == "/instances":
+                    return self._json({"now": registry._clock(),
+                                       "stale_after_s":
+                                           registry.tsdb.stale_after_s,
+                                       "instances":
+                                           registry.tsdb.instances()})
                 if path == "/healthz":
                     return self._json({"ok": True})
                 self._reply(404, b"{}")
+
+            def _query(self):
+                """GET /query — selector + window aggregation over the
+                fleet TSDB. Query params: family (required), agg,
+                window_s, by (comma-joined), q, match.<label>=<value>
+                matchers; range=1 adds step_s/span_s and returns a
+                point series (the --watch sparkline feed)."""
+                from urllib.parse import parse_qs
+                qs = (parse_qs(self.path.split("?", 1)[1])
+                      if "?" in self.path else {})
+
+                def one(key, default=None):
+                    return (qs.get(key) or [default])[0]
+
+                family = one("family")
+                if not family:
+                    return self._reply(400, json.dumps(
+                        {"error": "family parameter required"}).encode())
+                matchers = {k[6:]: v[0] for k, v in qs.items()
+                            if k.startswith("match.")}
+                try:
+                    if one("range"):
+                        res = registry.tsdb.range_query(
+                            family, agg=one("agg", "sum"),
+                            window_s=float(one("window_s", "60")),
+                            step_s=float(one("step_s", "10")),
+                            span_s=float(one("span_s", "300")),
+                            matchers=matchers or None,
+                            q=float(one("q", "0.99")))
+                    else:
+                        by = tuple(x for x in (one("by") or "").split(",")
+                                   if x)
+                        res = registry.tsdb.query(
+                            family, agg=one("agg", "latest"),
+                            window_s=float(one("window_s", "60")),
+                            matchers=matchers or None, by=by,
+                            q=float(one("q", "0.99")))
+                except ValueError as e:
+                    return self._reply(400, json.dumps(
+                        {"error": str(e)}).encode())
+                return self._json(res)
 
             def do_PUT(self):
                 parts = self.path.strip("/").split("/")
@@ -380,6 +455,26 @@ class TelemetryRegistry:
                         return self._reply(409, json.dumps(
                             {"ok": False, "epoch": epoch}).encode())
                     return self._json({"ok": True, "epoch": epoch})
+                if len(parts) == 1 and parts[0] == "push":
+                    body = self._body()
+                    instance = str(body.get("instance", ""))
+                    if not instance:
+                        return self._reply(400, json.dumps(
+                            {"error": "instance required"}).encode())
+                    now = body.get("now")
+                    try:
+                        n = registry.push_metrics(
+                            instance, str(body.get("job", "")),
+                            snapshot=body.get("snapshot"),
+                            exposition=body.get("exposition"),
+                            now=None if now is None else float(now))
+                    except ValueError as e:
+                        return self._reply(400, json.dumps(
+                            {"error": str(e)}).encode())
+                    return self._json({"ok": True, "samples": n})
+                if len(parts) == 2 and parts[0] == "stale":
+                    registry.mark_instance_stale(parts[1])
+                    return self._json({"ok": True})
                 self._reply(404, b"{}")
 
             do_POST = do_PUT
@@ -521,6 +616,57 @@ class RegistryClient:
     def metrics(self) -> str:
         req = urllib.request.Request(self._base + "/metrics")
         return self._fetch(req, op="GET /metrics").decode()
+
+    # -- fleet TSDB (remote-write + query) ---------------------------------
+
+    def push_metrics(self, instance: str, job: str,
+                     snapshot: dict | None = None,
+                     exposition: str | None = None,
+                     now: float | None = None) -> int:
+        """One remote-write push; returns the samples stored."""
+        body: dict = {"instance": instance, "job": job}
+        if snapshot is not None:
+            body["snapshot"] = snapshot
+        if exposition is not None:
+            body["exposition"] = exposition
+        if now is not None:
+            body["now"] = float(now)
+        res = self._request("POST", "/push", body)
+        return int(res.get("samples", 0))
+
+    def query(self, family: str, agg: str = "latest",
+              window_s: float = 60.0, matchers: dict | None = None,
+              by=(), q: float = 0.99) -> dict:
+        """``GET /query`` — one windowed aggregation across the fleet."""
+        from urllib.parse import urlencode
+        params = {"family": family, "agg": agg, "window_s": window_s,
+                  "q": q}
+        if by:
+            params["by"] = ",".join(by)
+        for k, v in (matchers or {}).items():
+            params[f"match.{k}"] = v
+        return self._request("GET", "/query?" + urlencode(params))
+
+    def query_range(self, family: str, agg: str = "sum",
+                    window_s: float = 60.0, step_s: float = 10.0,
+                    span_s: float = 300.0,
+                    matchers: dict | None = None,
+                    q: float = 0.99) -> dict:
+        from urllib.parse import urlencode
+        params = {"family": family, "agg": agg, "window_s": window_s,
+                  "step_s": step_s, "span_s": span_s, "q": q, "range": 1}
+        for k, v in (matchers or {}).items():
+            params[f"match.{k}"] = v
+        return self._request("GET", "/query?" + urlencode(params))
+
+    def instances(self) -> dict:
+        """``{"now", "stale_after_s", "instances": [...]}`` — push
+        freshness per known instance (doctor's freshness probe)."""
+        return self._request("GET", "/instances")
+
+    def mark_stale(self, instance: str) -> None:
+        """Retire an instance's series now (clean shutdown)."""
+        self._request("POST", f"/stale/{instance}")
 
 
 def main(argv=None) -> None:

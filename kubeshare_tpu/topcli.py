@@ -13,13 +13,28 @@ Usage::
                                    [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json] [--latency]
                                    [--health] [--autopilot] [--serving]
+                                   [--fleet] [--critpath --spans PATH ...]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
 ``--latency`` switches from the fleet table to the self-observability
 view: phase-latency percentiles (p50/p90/p99 from the exposition's
 histogram buckets, ``doc/observability.md``) plus per-chip token
 utilization — scraped from the scheduler's ``/metrics`` when
-``--scheduler`` is given, else the registry's.
+``--scheduler`` is given, else the registry's. Under ``--watch`` the
+scrapes feed a local :class:`~kubeshare_tpu.obs.tsdb.TimeSeriesStore`
+so percentiles come from *windowed* bucket increases — immune to the
+negative-delta artifacts raw cumulative buckets show across a proxy
+restart.
+``--fleet`` renders the remote-write telemetry plane
+(``doc/observability.md``): per-instance push freshness from the
+registry's ``/instances`` plus fleet-wide windowed aggregations, each
+one ``GET /query`` evaluated registry-side over every live instance —
+not N per-process scrapes. Under ``--watch``, range queries add
+sparkline history.
+``--critpath`` is offline: it assembles spans sharing a trace ID from
+``--spans`` files/dirs (tracer JSONL exports, flight-recorder dumps)
+and attributes each traced request's wall time to named segments
+(``obs/critpath.py``).
 ``--health`` renders the liveness plane (``doc/health.md``): per-node
 lease age and health state (+ time since the last transition), joined
 from the registry's ``/leases`` and — when ``--scheduler`` is given —
@@ -339,6 +354,135 @@ def render_serving(snap: dict) -> str:
     return "\n".join(lines)
 
 
+#: (label, family, agg, q, unit) — the fleet-wide aggregations the
+#: --fleet view evaluates, one GET /query each, registry-side
+FLEET_PANELS = (
+    ("rpc p50", "kubeshare_proxy_rpc_latency_seconds",
+     "quantile", 0.50, "s"),
+    ("rpc p99", "kubeshare_proxy_rpc_latency_seconds",
+     "quantile", 0.99, "s"),
+    ("rpc rate", "kubeshare_proxy_rpc_latency_seconds_count",
+     "rate", None, "/s"),
+    ("queue wait p99", "kubeshare_sched_queue_wait_seconds",
+     "quantile", 0.99, "s"),
+    ("token util avg", "kubeshare_token_utilization_ratio",
+     "avg", None, "ratio"),
+    ("pending pods", "kubeshare_scheduler_pending_pods",
+     "sum", None, ""),
+)
+
+#: (label, family, agg) — panels that get sparkline history in --watch
+FLEET_SPARKS = (
+    ("rpc rate", "kubeshare_proxy_rpc_latency_seconds_count", "rate"),
+    ("pending pods", "kubeshare_scheduler_pending_pods", "sum"),
+)
+
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list) -> str:
+    """Unicode sparkline; ``None`` (no data at that step) renders '·'."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return "·" * len(values)
+    lo, hi = min(present), max(present)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v is None:
+            out.append("·")
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_BARS) - 1))
+            out.append(_SPARK_BARS[idx])
+    return "".join(out)
+
+
+def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
+    """Telemetry-plane join: push freshness per instance (``/instances``)
+    plus the FLEET_PANELS aggregations — each a single ``GET /query``
+    evaluated by the registry's TSDB across every live instance."""
+    inst = client.instances()
+    panels = []
+    for label, family, agg, q, unit in FLEET_PANELS:
+        res = client.query(family, agg=agg, window_s=window_s,
+                           q=q if q is not None else 0.99)
+        groups = res.get("groups", [])
+        panels.append({"label": label, "family": family, "agg": agg,
+                       "q": q, "unit": unit,
+                       "value": groups[0]["value"] if groups else None,
+                       "series": res.get("series_matched", 0)})
+    # per-instance RPC rate joins the freshness table — still ONE query,
+    # grouped by instance registry-side
+    res = client.query("kubeshare_proxy_rpc_latency_seconds_count",
+                       agg="rate", window_s=window_s, by=("instance",))
+    rates = {g["labels"].get("instance", ""): g["value"]
+             for g in res.get("groups", [])}
+    instances = inst.get("instances", [])
+    for i in instances:
+        i["rpc_rate"] = rates.get(i["instance"])
+    return {"now": inst.get("now"),
+            "stale_after_s": inst.get("stale_after_s"),
+            "window_s": float(window_s),
+            "instances": instances, "panels": panels}
+
+
+def fleet_history(client: RegistryClient, watch_s: float,
+                  window_s: float = 60.0) -> dict:
+    """Sparkline feed for ``--fleet --watch``: one range query per
+    FLEET_SPARKS panel (instant query per step, registry-side)."""
+    step = max(5.0, float(watch_s))
+    hist = {}
+    for label, family, agg in FLEET_SPARKS:
+        try:
+            rr = client.query_range(family, agg=agg, window_s=window_s,
+                                    step_s=step, span_s=step * 40)
+        except Exception:
+            continue          # history is decoration; the table stands
+        hist[label] = [p["value"] for p in rr.get("points", [])]
+    return hist
+
+
+def _fmt_panel(value, unit: str) -> str:
+    if value is None:
+        return "-"
+    if unit == "s":
+        return _fmt_seconds(float(value))
+    if unit == "/s":
+        return f"{value:.2f}/s"
+    if unit == "ratio":
+        return f"{value:.2f}"
+    return f"{value:g}"
+
+
+def render_fleet(snap: dict) -> str:
+    lines = [f"FLEET TELEMETRY (remote-write TSDB, doc/observability.md) "
+             f"— window {snap['window_s']:.0f}s"]
+    insts = snap["instances"]
+    if not insts:
+        lines.append("  no instances have pushed — remote-write is the "
+                     "feed (scheduler: on by default; chipproxy "
+                     "--remote-write; launcherd --registry-host)")
+    else:
+        lines.append(f"  {'instance':<24} {'job':<12} {'age':>7} "
+                     f"{'pushes':>7} {'series':>7} {'rpc/s':>8}  state")
+        for i in insts:
+            rate = (f"{i['rpc_rate']:.2f}" if i.get("rpc_rate") is not None
+                    else "-")
+            state = "STALE" if i.get("stale") else "live"
+            lines.append(
+                f"  {i['instance']:<24} {i.get('job', ''):<12} "
+                f"{i['age_s']:>6.1f}s {i.get('pushes', 0):>7} "
+                f"{i.get('samples', 0):>7} {rate:>8}  {state}")
+    lines.append("AGGREGATES (one GET /query each, evaluated "
+                 "registry-side across instances)")
+    for p in snap["panels"]:
+        lines.append(f"  {p['label']:<16} {_fmt_panel(p['value'], p['unit']):>10}"
+                     f"   ({p['series']} series)")
+    for label, values in (snap.get("history") or {}).items():
+        lines.append(f"  {label:<16} {_sparkline(values)}")
+    return "\n".join(lines)
+
+
 def _fmt_seconds(s: float) -> str:
     if s != s:                       # NaN: series exists but has no samples
         return "-"
@@ -349,15 +493,42 @@ def _fmt_seconds(s: float) -> str:
     return f"{s:.2f}s"
 
 
-def latency_snapshot(text: str) -> dict:
+def latency_snapshot(text: str, store=None, window_s: float = 60.0,
+                     now: float | None = None) -> dict:
     """Exposition text → ``{histograms: [...], utilization: [...]}``.
 
-    Each histogram series becomes p50/p90/p99 estimated from its
+    One-shot (``store is None``): p50/p90/p99 estimated from the raw
     cumulative buckets (PromQL ``histogram_quantile`` math,
     ``obs.metrics.quantile_from_buckets``) — one row per label set.
+
+    Watch mode feeds each scrape into a local
+    :class:`~kubeshare_tpu.obs.tsdb.TimeSeriesStore` and computes the
+    percentiles from *windowed bucket increases* instead. Cumulative
+    buckets go backwards when the scraped process restarts mid-session;
+    the TSDB's reset-aware increase keeps the deltas non-negative, so
+    the quantiles stay truthful across a proxy/scheduler restart.
     """
     from .obs.metrics import parse_exposition, quantile_from_buckets
     families = parse_exposition(text)
+    if store is not None:
+        store.ingest("scrape", "scrape", exposition=text, now=now)
+
+    def _windowed(fname: str, labels: dict) -> dict:
+        matchers = dict(labels)
+        matchers["instance"] = "scrape"
+        out = {}
+        for pname, qv in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+            res = store.query(fname, agg="quantile", q=qv,
+                              window_s=window_s, matchers=matchers,
+                              now=now)
+            g = res["groups"]
+            v = g[0]["value"] if g else None
+            out[pname] = float("nan") if v is None else v
+        res = store.query(fname + "_count", agg="increase",
+                          window_s=window_s, matchers=matchers, now=now)
+        g = res["groups"]
+        out["count"] = int(g[0]["value"]) if g and g[0]["value"] else 0
+        return out
 
     hists = []
     for fname, fam in sorted(families.items()):
@@ -387,7 +558,7 @@ def latency_snapshot(text: str) -> dict:
         for key, s in sorted(series.items()):
             bounds = [b for b, _ in sorted(s["buckets"])]
             cums = [int(c) for _, c in sorted(s["buckets"])]
-            hists.append({
+            row = {
                 "family": fname,
                 "labels": dict(key),
                 "count": s["count"],
@@ -396,7 +567,10 @@ def latency_snapshot(text: str) -> dict:
                 "p90": quantile_from_buckets(bounds, cums, 0.90),
                 "p99": quantile_from_buckets(bounds, cums, 0.99),
                 "exemplar": s["exemplar"],
-            })
+            }
+            if store is not None:
+                row.update(_windowed(fname, dict(key)))
+            hists.append(row)
 
     util = []
     fam = families.get("kubeshare_token_utilization_ratio")
@@ -406,11 +580,14 @@ def latency_snapshot(text: str) -> dict:
             util.append({"chip": labels.get("chip", "?"),
                          "client": labels.get("client", "?"),
                          "ratio": value})
-    return {"histograms": hists, "utilization": util}
+    return {"histograms": hists, "utilization": util,
+            "windowed_s": window_s if store is not None else None}
 
 
 def render_latency(lat: dict, source: str) -> str:
-    lines = [f"LATENCY ({source})"]
+    mode = (f"windowed {lat['windowed_s']:.0f}s, reset-aware"
+            if lat.get("windowed_s") else "cumulative since start")
+    lines = [f"LATENCY ({source}, {mode})"]
     rows = lat["histograms"]
     if not rows:
         lines.append("  no histogram families in the exposition — nothing "
@@ -440,6 +617,32 @@ def _fetch_exposition(url: str, timeout: float = 5.0) -> str:
     import urllib.request
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read().decode()
+
+
+def _critpath_main(args) -> int:
+    """Offline critical-path report over tracer/flight span files."""
+    import glob
+    import os
+    from .obs import critpath
+    paths = []
+    for p in args.spans:
+        if os.path.isdir(p):
+            paths.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            paths.append(p)
+    if not paths:
+        print("kubeshare-top: --critpath needs --spans FILE_OR_DIR ... "
+              "(tracer JSONL exports and/or flight-recorder dumps)",
+              file=sys.stderr)
+        return 2
+    spans = critpath.load_spans(paths)
+    traces = critpath.assemble(spans, trace_id=args.trace)
+    rep = critpath.report(traces)
+    if args.json:
+        print(json.dumps({"report": rep, "traces": traces}))
+    else:
+        sys.stdout.write(critpath.render_report(rep, traces))
+    return 0 if traces else 2
 
 
 def _opportunistic(priority: str) -> bool:
@@ -513,7 +716,27 @@ def main(argv=None) -> int:
                              "depth, admit/shed rates and p50/p99 (needs "
                              "--scheduler for /serving state) instead "
                              "of the fleet table")
+    parser.add_argument("--fleet", action="store_true",
+                        help="remote-write telemetry plane: per-instance "
+                             "push freshness + fleet-wide windowed "
+                             "aggregations via the registry's GET /query "
+                             "(sparkline history under --watch)")
+    parser.add_argument("--critpath", action="store_true",
+                        help="offline: assemble --spans files into a "
+                             "per-segment critical-path report "
+                             "(admission/queue/schedule/grant/transport/"
+                             "execute)")
+    parser.add_argument("--spans", nargs="*", default=[],
+                        help="span JSONL files or directories for "
+                             "--critpath (tracer exports, flight dumps)")
+    parser.add_argument("--trace", default=None,
+                        help="restrict --critpath to one trace id")
+    parser.add_argument("--window", type=float, default=60.0,
+                        help="aggregation window in seconds for --fleet "
+                             "and watch-mode --latency (default 60)")
     args = parser.parse_args(argv)
+    if args.critpath:
+        return _critpath_main(args)
     host, _, port = args.registry.rpartition(":")
     client = RegistryClient(host or "127.0.0.1", int(port))
     scheduler = None
@@ -536,6 +759,16 @@ def main(argv=None) -> int:
             host_part = host or "127.0.0.1"
             metrics_url = f"http://{host_part}:{port}/metrics"
 
+    # watch-mode --latency: consecutive scrapes feed a local TSDB so
+    # quantiles come from reset-aware windowed increases, not raw
+    # cumulative buckets (which go backwards across a proxy restart)
+    lat_store = None
+    lat_window = max(args.window, 5.0 * args.watch)
+    if args.latency and args.watch > 0:
+        from .obs.tsdb import TimeSeriesStore
+        lat_store = TimeSeriesStore(stale_after_s=lat_window + args.watch,
+                                    retention_s=2.0 * lat_window)
+
     try:
         while True:
             try:
@@ -550,8 +783,17 @@ def main(argv=None) -> int:
                 elif args.health:
                     hs = health_snapshot(client, scheduler)
                     out = json.dumps(hs) if args.json else render_health(hs)
+                elif args.fleet:
+                    fs = fleet_snapshot(client, window_s=args.window)
+                    if args.watch > 0:
+                        fs["history"] = fleet_history(
+                            client, args.watch, window_s=args.window)
+                    out = (json.dumps(fs) if args.json
+                           else render_fleet(fs))
                 elif args.latency:
-                    lat = latency_snapshot(_fetch_exposition(metrics_url))
+                    lat = latency_snapshot(_fetch_exposition(metrics_url),
+                                           store=lat_store,
+                                           window_s=lat_window)
                     out = (json.dumps(lat) if args.json
                            else render_latency(lat, metrics_url))
                 else:

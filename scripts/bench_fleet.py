@@ -1,0 +1,285 @@
+"""Fleet telemetry-plane micro-benchmark: what remote-write costs and
+how fast fleet queries answer (doc/observability.md).
+
+The telemetry plane only works if pushing is cheap enough for every
+process to do it every few seconds, and querying is cheap enough for
+``topcli --fleet --watch`` to hammer. This bench puts numbers on both
+ends plus the critical-path assembler the CI gate rides on:
+
+- ``ingest_ms_p50`` / ``ingest_ms_p99``: server-side cost of one
+  remote-write push carrying a 1k-sample snapshot (one histogram
+  family + counter/gauge families across 10 shard labelsets) into the
+  registry's :class:`~kubeshare_tpu.obs.tsdb.TimeSeriesStore`.
+- ``collect_us``: client-side cost of ``MetricsRegistry.collect()`` —
+  what the pushing process pays to build the snapshot.
+- ``push_http_ms_p50``: one full ``POST /push`` round trip (collect +
+  JSON + HTTP + ingest) against a live registry on loopback.
+- ``query_http_ms_p50`` / ``_p99``: ``GET /query`` (rate over a 60 s
+  window) against a TSDB populated with 16 instances x 10 min of
+  pushes — the ``--fleet`` panel workload.
+- ``query_quantile_http_ms_p50``: the heavier fleet-wide
+  histogram-quantile aggregation over the same population.
+- ``critpath_coverage_mean`` / ``_min``: attributed fraction of wall
+  time over the sim's deterministic virtual-time traces (4 sources),
+  plus ``critpath_assemble_ms`` for the assembly cost.
+
+Run: ``python scripts/bench_fleet.py`` → one JSON object (committed as
+``bench_fleet.json``). ``--baseline FILE`` prints deltas; ``--write
+FILE`` saves fresh numbers (``make bench-fleet`` does both).
+``--check`` exits non-zero unless the acceptance bars hold: ingest
+< 1 ms/push at 1k samples, fleet query p50 < 10 ms over 16 instances
+x 10 min retention, critpath coverage >= 95%.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line
+_METRICS = ("ingest_ms_p50", "ingest_ms_p99", "collect_us",
+            "push_http_ms_p50", "query_http_ms_p50", "query_http_ms_p99",
+            "query_quantile_http_ms_p50", "critpath_coverage_min",
+            "critpath_assemble_ms")
+#: coverage is the only higher-is-better number here
+_HIGHER_IS_BETTER = ("critpath_coverage_min",)
+
+INGEST_PUSHES = 300
+QUERY_N = 200
+FLEET_INSTANCES = 16
+FLEET_MINUTES = 10
+FLEET_PUSH_PERIOD_S = 10.0
+CRITPATH_REQUESTS = 50
+
+
+def _quantiles(vals: list) -> tuple:
+    s = sorted(vals)
+    return s[len(s) // 2], s[min(len(s) - 1, int(len(s) * 0.99))]
+
+
+def make_snapshot(n_samples: int = 1000, scale: float = 1.0) -> dict:
+    """A realistic 1k-sample push: one RPC-latency histogram (9 buckets
+    + sum + count per op) and counter families spread over 10 shard
+    labelsets. ``scale`` grows the counters so consecutive pushes look
+    like live traffic, not a frozen process."""
+    les = ("0.001", "0.005", "0.01", "0.05", "0.1", "0.5", "1", "5",
+           "+Inf")
+    families = {"bench_rpc_latency_seconds": "histogram"}
+    samples = []
+    ops = ("execute", "grant", "release", "status")
+    for op in ops:
+        cum = 0.0
+        for le in les:
+            cum += 10.0 * scale
+            samples.append(("bench_rpc_latency_seconds_bucket",
+                            {"le": le, "op": op}, cum))
+        samples.append(("bench_rpc_latency_seconds_sum", {"op": op},
+                        3.5 * scale))
+        samples.append(("bench_rpc_latency_seconds_count", {"op": op},
+                        cum))
+    fam_i = 0
+    while len(samples) < n_samples:
+        fam = f"bench_counter_{fam_i}_total"
+        families[fam] = "counter"
+        for shard in range(10):
+            if len(samples) >= n_samples:
+                break
+            samples.append((fam, {"shard": str(shard)},
+                            float(fam_i + shard) * scale))
+        fam_i += 1
+    return {"families": families, "samples": samples[:n_samples]}
+
+
+def bench_ingest() -> dict:
+    from kubeshare_tpu.obs.tsdb import TimeSeriesStore
+
+    store = TimeSeriesStore()
+    costs = []
+    for i in range(INGEST_PUSHES):
+        snap = make_snapshot(1000, scale=float(i + 1))
+        t0 = time.perf_counter()
+        store.ingest("bench-instance", "chipproxy", snapshot=snap,
+                     now=float(i))
+        costs.append((time.perf_counter() - t0) * 1e3)
+    p50, p99 = _quantiles(costs)
+    return {"ingest_ms_p50": round(p50, 3), "ingest_ms_p99": round(p99, 3),
+            "ingest_series": store.series_count()}
+
+
+def bench_collect() -> dict:
+    from kubeshare_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    hist = reg.histogram("bench_rpc_seconds", "bench", ("op",))
+    ctr = reg.counter("bench_ops_total", "bench", ("op", "status"))
+    for op in ("a", "b", "c", "d"):
+        for _ in range(100):
+            hist.observe(op, value=0.01)
+            ctr.inc(op, "ok")
+    t0 = time.perf_counter()
+    n = 200
+    for _ in range(n):
+        reg.collect()
+    return {"collect_us": round((time.perf_counter() - t0) / n * 1e6, 1)}
+
+
+def _populated_registry():
+    """A live registry whose TSDB holds 16 instances x 10 min of pushes
+    at the stock 1k-sample size — the --fleet query workload."""
+    from kubeshare_tpu.obs.tsdb import TimeSeriesStore
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+
+    now0 = time.time() - FLEET_MINUTES * 60.0
+    store = TimeSeriesStore(stale_after_s=1e9)
+    steps = int(FLEET_MINUTES * 60.0 / FLEET_PUSH_PERIOD_S)
+    for step in range(steps):
+        t = now0 + step * FLEET_PUSH_PERIOD_S
+        snap = make_snapshot(1000, scale=float(step + 1))
+        for i in range(FLEET_INSTANCES):
+            store.ingest(f"proxy-{i}", "chipproxy", snapshot=snap, now=t)
+    reg = TelemetryRegistry(tsdb=store)
+    return reg, reg.serve()
+
+
+def bench_query() -> dict:
+    from kubeshare_tpu.telemetry.registry import RegistryClient
+
+    reg, srv = _populated_registry()
+    client = RegistryClient("127.0.0.1", srv.server_address[1])
+    try:
+        # one HTTP push round trip against the same live registry
+        push_costs = []
+        snap = make_snapshot(1000)
+        for i in range(50):
+            t0 = time.perf_counter()
+            client.push_metrics("push-bench", "chipproxy", snapshot=snap)
+            push_costs.append((time.perf_counter() - t0) * 1e3)
+
+        rate_costs = []
+        for _ in range(QUERY_N):
+            t0 = time.perf_counter()
+            res = client.query("bench_rpc_latency_seconds_count",
+                               agg="rate", window_s=60.0)
+            rate_costs.append((time.perf_counter() - t0) * 1e3)
+        assert res["series_matched"] >= FLEET_INSTANCES, res
+
+        q_costs = []
+        for _ in range(QUERY_N // 4):
+            t0 = time.perf_counter()
+            client.query("bench_rpc_latency_seconds", agg="quantile",
+                         q=0.99, window_s=60.0)
+            q_costs.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    p50, p99 = _quantiles(rate_costs)
+    return {"push_http_ms_p50": round(_quantiles(push_costs)[0], 3),
+            "query_http_ms_p50": round(p50, 3),
+            "query_http_ms_p99": round(p99, 3),
+            "query_quantile_http_ms_p50":
+                round(_quantiles(q_costs)[0], 3),
+            "query_instances": FLEET_INSTANCES,
+            "query_retention_min": FLEET_MINUTES}
+
+
+def bench_critpath() -> dict:
+    from kubeshare_tpu.obs import critpath
+    from kubeshare_tpu.sim.simulator import simulate_critpath
+
+    out = simulate_critpath(CRITPATH_REQUESTS, seed=0)
+    rep = out["report"]
+    t0 = time.perf_counter()
+    sim = simulate_critpath(CRITPATH_REQUESTS, seed=0)
+    critpath.report(sim["traces"])
+    assemble_ms = (time.perf_counter() - t0) * 1e3
+    return {"critpath_coverage_mean": rep["coverage_mean"],
+            "critpath_coverage_min": rep["coverage_min"],
+            "critpath_sources": len(rep["sources"]),
+            "critpath_traces": rep["traces"],
+            "critpath_assemble_ms": round(assemble_ms, 2)}
+
+
+def run_bench() -> dict:
+    out = {}
+    out.update(bench_ingest())
+    out.update(bench_collect())
+    out.update(bench_query())
+    out.update(bench_critpath())
+    return out
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (doc/observability.md): remote-write cheap
+    enough for every process, queries fast enough for --watch, and the
+    critical path actually accounted for."""
+    bars = [
+        ("ingest_ms_p50", out["ingest_ms_p50"] < 1.0,
+         "server-side ingest must stay under 1 ms per 1k-sample push"),
+        ("query_http_ms_p50", out["query_http_ms_p50"] < 10.0,
+         "fleet rate query p50 must stay under 10 ms over "
+         f"{FLEET_INSTANCES} instances x {FLEET_MINUTES} min"),
+        ("query_quantile_http_ms_p50",
+         out["query_quantile_http_ms_p50"] < 50.0,
+         "fleet histogram-quantile must stay interactive"),
+        ("critpath_coverage_min", out["critpath_coverage_min"] >= 0.95,
+         "critical-path attribution must cover >= 95% of wall time"),
+        ("critpath_sources", out["critpath_sources"] >= 3,
+         "attribution must span >= 3 processes"),
+    ]
+    failed = [f"{name}: {why} (got {out[name]})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:30s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:30s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_fleet")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the ingest/query/coverage "
+                             "bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Critical-path assembly: durations across incomparable tracer epochs."""
+
+import json
+
+from kubeshare_tpu.obs import critpath
+from kubeshare_tpu.obs.trace import Tracer
+from kubeshare_tpu.sim.simulator import simulate_critpath
+
+
+def span(name, tid, start, end, source="test", parent="", **attrs):
+    return {"name": name, "trace_id": tid, "span_id": name + "-id",
+            "parent_id": parent, "start_ms": float(start),
+            "end_ms": float(end), "source": source, "attrs": attrs}
+
+
+def test_parent_child_interval_union_no_double_count():
+    """filter ⊂ bind-ish nesting from ONE source must union, not sum."""
+    rows = [
+        span("submit", "t1", 0.0, 100.0, source="scheduler"),
+        span("filter", "t1", 10.0, 40.0, source="scheduler"),
+        span("reserve", "t1", 20.0, 35.0, source="scheduler",
+             parent="filter-id"),
+        span("bind", "t1", 40.0, 50.0, source="scheduler"),
+    ]
+    traces = critpath.assemble(rows)
+    assert len(traces) == 1
+    # [10,40] ∪ [20,35] ∪ [40,50] = 40 ms, not 30+15+10
+    assert traces[0]["segments"]["schedule"] == 40.0
+    assert traces[0]["wall_ms"] == 100.0
+
+
+def test_transport_envelope_subtracts_execute():
+    """Client round-trip time contains the proxy's execute: attributed
+    transport is the difference, so segments partition the wall clock."""
+    rows = [
+        span("submit", "t1", 0.0, 60.0, source="scheduler"),
+        # client clock: epoch wildly different from the scheduler's
+        span("transport", "t1", 5_000_000.0, 5_000_050.0, source="client"),
+        # proxy clock: yet another epoch; execute took 42 of those 50 ms
+        span("execute", "t1", 777_000.0, 777_042.0, source="chipproxy"),
+    ]
+    tr = critpath.assemble(rows)[0]
+    assert tr["segments"]["execute"] == 42.0
+    assert tr["segments"]["transport"] == 8.0      # 50 − 42
+    assert tr["attributed_ms"] == 50.0
+    assert tr["sources"] == ["chipproxy", "client", "scheduler"]
+
+
+def test_transport_envelope_clamps_at_zero():
+    rows = [
+        span("submit", "t1", 0.0, 60.0, source="scheduler"),
+        span("transport", "t1", 0.0, 10.0, source="client"),
+        span("execute", "t1", 0.0, 30.0, source="chipproxy"),
+    ]
+    tr = critpath.assemble(rows)[0]
+    assert tr["segments"]["transport"] == 0.0      # never negative
+
+
+def test_traces_without_root_are_skipped_and_unknown_names_ignored():
+    rows = [
+        span("filter", "orphan", 0.0, 10.0),
+        span("submit", "ok", 0.0, 10.0),
+        span("migrate", "ok", 2.0, 5.0),           # not on the request path
+    ]
+    traces = critpath.assemble(rows)
+    assert [t["trace_id"] for t in traces] == ["ok"]
+    assert sum(traces[0]["segments"].values()) == 0.0
+
+
+def test_trace_id_filter():
+    rows = [span("submit", "a", 0.0, 10.0), span("submit", "b", 0.0, 10.0)]
+    assert [t["trace_id"]
+            for t in critpath.assemble(rows, trace_id="b")] == ["b"]
+
+
+def test_load_spans_tracer_export_and_flight_dump_mix(tmp_path):
+    """One file per process: a tracer JSONL export and a flight dump
+    with a trigger header + non-span noise. proc attr beats basename."""
+    tr = Tracer()
+    tr.record("submit", "t9", 100.0, 200.0)
+    tr.record("queue-wait", "t9", 110.0, 150.0)
+    export = tmp_path / "scheduler.jsonl"
+    tr.export_jsonl(str(export))
+
+    dump = tmp_path / "flightdump.jsonl"
+    with open(dump, "w") as fh:
+        fh.write(json.dumps({"kind": "trigger", "reason": "test"}) + "\n")
+        fh.write(json.dumps({"kind": "note", "text": "hi"}) + "\n")
+        fh.write(json.dumps({"kind": "span", "name": "execute",
+                             "trace_id": "t9", "start_ms": 0.0,
+                             "end_ms": 30.0,
+                             "attrs": {"proc": "chipproxy"}}) + "\n")
+        # open span (no end) must be skipped, not crash
+        fh.write(json.dumps({"kind": "span", "name": "execute",
+                             "trace_id": "t9", "start_ms": 40.0}) + "\n")
+
+    spans = critpath.load_spans([str(export), str(dump)])
+    assert len(spans) == 3
+    tr9 = critpath.assemble(spans)[0]
+    assert tr9["sources"] == ["chipproxy", "scheduler"]
+    assert tr9["segments"]["queue-wait"] == 40.0
+    assert tr9["segments"]["execute"] == 30.0
+
+
+def test_spans_from_flight_entries_filters_kinds():
+    entries = [
+        {"kind": "alert", "name": "x"},
+        {"kind": "span", "name": "token-grant", "trace_id": "t",
+         "start_ms": 1.0, "end_ms": 2.0},
+        {"kind": "span", "name": "open", "trace_id": "t",
+         "start_ms": 1.0},                          # open: skipped
+    ]
+    rows = critpath.spans_from_flight_entries(entries, source="ring")
+    assert len(rows) == 1 and rows[0]["source"] == "ring"
+
+
+def test_report_percentiles_and_coverage():
+    rows = []
+    for i, wall in enumerate((10.0, 20.0, 100.0)):
+        tid = "t%d" % i
+        rows.append(span("submit", tid, 0.0, wall, source="scheduler"))
+        rows.append(span("execute", tid, 0.0, wall * 0.9,
+                         source="chipproxy"))
+    rep = critpath.report(critpath.assemble(rows))
+    assert rep["traces"] == 3
+    assert rep["wall_p50_ms"] == 20.0 and rep["wall_p99_ms"] == 100.0
+    assert rep["coverage_mean"] == 0.9 and rep["coverage_min"] == 0.9
+    assert rep["segments"]["execute"]["share"] == 0.9
+    out = critpath.render_report(rep, critpath.assemble(rows))
+    assert "critical path" in out and "execute" in out
+
+
+def test_sim_critpath_is_deterministic_and_covered(tmp_path):
+    """The sim's virtual-time traces: ≥3 processes, ≥95% coverage, and
+    byte-identical reports across runs (the CI gate's substrate)."""
+    out1 = simulate_critpath(12, seed=7, spans_dir=str(tmp_path / "a"))
+    out2 = simulate_critpath(12, seed=7, spans_dir=str(tmp_path / "b"))
+    assert out1["report"] == out2["report"]
+    rep = out1["report"]
+    assert rep["traces"] == 12
+    assert len(rep["sources"]) >= 3
+    assert rep["coverage_min"] >= 0.95
+    # the per-source exports reassemble to the same attribution
+    files = sorted(str(p) for p in (tmp_path / "a").glob("*.jsonl"))
+    assert len(files) >= 3
+    re_rep = critpath.report(critpath.assemble(critpath.load_spans(files)))
+    assert re_rep["coverage_min"] >= 0.95
+    assert re_rep["traces"] == 12

@@ -54,8 +54,9 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
                       "--scheduler", "127.0.0.1:1"])
     out = capsys.readouterr().out
     assert rc == 1
-    # registry + scheduler + autopilot + serving + slo + leases all refuse
-    assert out.count("fail") == 6
+    # registry + fleetquery + scheduler + autopilot + serving + slo +
+    # leases all refuse
+    assert out.count("fail") == 7
 
 
 def test_doctor_cli_subprocess():
@@ -121,8 +122,9 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
                       "--scheduler", f"127.0.0.1:{ports[1]}"])
     out = capsys.readouterr().out
     assert rc == 1, out
-    # registry + scheduler + autopilot + serving + slo + leases all refuse
-    assert out.count("fail") == 6, out
+    # registry + fleetquery + scheduler + autopilot + serving + slo +
+    # leases all refuse
+    assert out.count("fail") == 7, out
 
 
 def test_doctor_serving_probe_skip_then_ok(capsys, monkeypatch):

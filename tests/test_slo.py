@@ -337,6 +337,43 @@ def test_flight_recorder_ring_and_crash_dump():
         parse_dump_jsonl("not jsonl")
 
 
+def test_flight_dump_dir_pruned_by_mtime(tmp_path):
+    """--flight-dump-dir retention: disk files are capped (oldest mtime
+    pruned first), so a long-lived proxy can't fill the node's disk
+    with crash dumps."""
+    import os
+
+    d = tmp_path / "dumps"
+    rec = FlightRecorder(capacity=8, clock=FakeClock(5.0),
+                         dump_dir=str(d), max_dump_files=3)
+    rec.note("test", "e0")
+    for i in range(3):
+        rec.trigger(f"r{i}")
+    files = sorted(os.listdir(d))
+    assert files == ["flight-000001.jsonl", "flight-000002.jsonl",
+                     "flight-000003.jsonl"]
+    # age them distinctly; the next trigger must evict the oldest mtime
+    for i, name in enumerate(files):
+        os.utime(d / name, (100.0 * (i + 1), 100.0 * (i + 1)))
+    rec.trigger("r3")
+    assert sorted(os.listdir(d)) == ["flight-000002.jsonl",
+                                     "flight-000003.jsonl",
+                                     "flight-000004.jsonl"]
+    # retention is reconfigurable at runtime (--flight-dump-cap)
+    rec.set_dump_retention(1)
+    rec.trigger("r4")
+    assert os.listdir(d) == ["flight-000005.jsonl"]
+
+    # the seq restarts with the process: a NEW recorder in the same dir
+    # re-uses low filenames, so pruning must go by mtime, not name
+    os.utime(d / "flight-000005.jsonl", (50.0, 50.0))
+    rec2 = FlightRecorder(capacity=8, clock=FakeClock(6.0),
+                          dump_dir=str(d), max_dump_files=1)
+    rec2.note("test", "after-restart")
+    rec2.trigger("post-restart")
+    assert os.listdir(d) == ["flight-000001.jsonl"]   # newest mtime wins
+
+
 def test_slo_gauges_rendered_in_exposition():
     clock = FakeClock(0.0)
     ev = fresh_eval(clock)

@@ -195,6 +195,96 @@ def test_journal_replay_equivalence_fuzzed(tmp_path):
     assert final.pods() == reg.pods()
 
 
+# -- remote-write × journal (doc/observability.md) ---------------------------
+
+
+def test_restart_replays_state_but_not_remote_written_series(tmp_path):
+    """The journal restores decision state (capacity/pods/leases); the
+    TSDB is deliberately NOT journaled — replaying samples would
+    resurrect instances that died while the registry was down as
+    fresh-looking series. A restart must come back with zero series."""
+    j = tmp_path / "registry.jsonl"
+    r1 = TelemetryRegistry(journal=j, clock=_TickClock(100.0))
+    r1.put_capacity("n0", [{"chip_id": "c0"}])
+    r1.put_pod("ns/p", {"node": "n0", "request": 0.5})
+    r1.put_lease("n0", 3)
+    stored = r1.push_metrics("proxy-0", "chipproxy", snapshot={
+        "families": {"kubeshare_pending": "gauge"},
+        "samples": [("kubeshare_pending", {}, 7.0)]}, now=100.0)
+    assert stored == 1
+    assert r1.tsdb.series_count() == 1
+    r1.close()
+
+    r2 = TelemetryRegistry(journal=j, clock=_TickClock(101.0))
+    assert "n0" in r2.capacity() and "ns/p" in r2.pods()
+    assert r2.leases()["n0"]["epoch"] == 3
+    assert r2.tsdb.series_count() == 0       # no resurrected samples
+    assert r2.tsdb.instances() == []
+    # the instance re-appears within one push period, history from zero
+    r2.push_metrics("proxy-0", "chipproxy", snapshot={
+        "families": {"kubeshare_pending": "gauge"},
+        "samples": [("kubeshare_pending", {}, 9.0)]}, now=101.0)
+    res = r2.tsdb.query("kubeshare_pending", agg="latest", window_s=60,
+                        now=101.0)
+    assert res["groups"][0]["value"] == 9.0
+    r2.close()
+
+
+def test_silent_instance_goes_stale_and_push_revives(tmp_path):
+    from kubeshare_tpu.obs.tsdb import TimeSeriesStore
+
+    clock = _TickClock(100.0)
+    reg = TelemetryRegistry(
+        clock=clock, tsdb=TimeSeriesStore(stale_after_s=15.0, clock=clock))
+    snap = {"families": {"kubeshare_pending": "gauge"},
+            "samples": [("kubeshare_pending", {}, 1.0)]}
+    reg.push_metrics("proxy-0", "chipproxy", snapshot=snap)
+    clock.t = 120.0                          # silent past stale_after_s
+    assert reg.tsdb.query("kubeshare_pending", window_s=60)["groups"] == []
+    assert reg.tsdb.instances()[0]["stale"] is True
+    reg.push_metrics("proxy-0", "chipproxy", snapshot=snap)
+    assert reg.tsdb.query("kubeshare_pending",
+                          window_s=60)["groups"][0]["value"] == 1.0
+
+
+def test_remote_writer_duck_types_against_in_process_registry():
+    """RemoteWriter pushes into a bare TelemetryRegistry (no HTTP) —
+    the duck-type the sim and the scheduler's in-process path rely on;
+    stop() retires the instance's series immediately."""
+    from kubeshare_tpu.telemetry.remote_write import RemoteWriter
+
+    clock = _TickClock(100.0)
+    reg = TelemetryRegistry(clock=clock)
+    wr = RemoteWriter(reg, "sched-0", "scheduler", collect=lambda: {
+        "families": {"kubeshare_scheduler_pending_pods": "gauge"},
+        "samples": [("kubeshare_scheduler_pending_pods", {}, 4.0)]})
+    assert wr.push_once(now=100.0) and wr.pushes_ok == 1
+    res = reg.tsdb.query("kubeshare_scheduler_pending_pods",
+                         agg="sum", window_s=60, now=100.0)
+    assert res["groups"][0]["value"] == 4.0
+    wr.stop()                                # never started: just mark_stale
+    assert reg.tsdb.query("kubeshare_scheduler_pending_pods",
+                          agg="sum", window_s=60, now=100.0)["groups"] == []
+    inst = reg.tsdb.instances(now=100.0)[0]
+    assert inst["instance"] == "sched-0" and inst["stale"] is True
+
+
+def test_remote_writer_survives_dead_client():
+    from kubeshare_tpu.telemetry.remote_write import RemoteWriter
+
+    class Dead:
+        def push_metrics(self, *a, **k):
+            raise OSError("connection refused")
+
+        def mark_stale(self, instance):
+            raise OSError("connection refused")
+
+    wr = RemoteWriter(Dead(), "p0", "chipproxy",
+                      collect=lambda: {"families": {}, "samples": []})
+    assert wr.push_once() is False and wr.pushes_failed == 1
+    wr.stop()                                # swallowed, never raises
+
+
 # -- heartbeat leases (doc/health.md) -----------------------------------------
 
 

@@ -195,6 +195,126 @@ def test_cli_serving_view_joins_front_door(capsys):
         srv.shutdown()
 
 
+def _hist_push(name, per_le, total):
+    """Compact snapshot holding one histogram family."""
+    samples = [(name + "_bucket", {"le": le}, float(c))
+               for le, c in per_le.items()]
+    samples += [(name + "_sum", {}, 1.0), (name + "_count", {}, float(total))]
+    return {"families": {name: "histogram"}, "samples": samples}
+
+
+def test_cli_fleet_view_aggregates_across_instances(capsys):
+    """--fleet: two proxies + a scheduler remote-write into the registry;
+    every aggregate is ONE GET /query evaluated registry-side — not N
+    per-process /metrics scrapes."""
+    import time
+    from kubeshare_tpu.telemetry.registry import RegistryClient
+
+    reg, srv, _ = serve_fleet()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    cli = RegistryClient("127.0.0.1", srv.server_address[1])
+    rpc = "kubeshare_proxy_rpc_latency_seconds"
+    t = time.time()
+    try:
+        for inst in ("proxy-0", "proxy-1"):
+            cli.push_metrics(inst, "chipproxy", snapshot=_hist_push(
+                rpc, {"0.01": 0, "0.1": 0, "+Inf": 0}, 0), now=t - 10.0)
+        cli.push_metrics("proxy-0", "chipproxy", snapshot=_hist_push(
+            rpc, {"0.01": 60, "0.1": 80, "+Inf": 100}, 100), now=t)
+        cli.push_metrics("proxy-1", "chipproxy", snapshot=_hist_push(
+            rpc, {"0.01": 0, "0.1": 10, "+Inf": 20}, 20), now=t)
+        cli.push_metrics("sched-0", "scheduler", snapshot={
+            "families": {"kubeshare_scheduler_pending_pods": "gauge"},
+            "samples": [("kubeshare_scheduler_pending_pods", {}, 5.0)]},
+            now=t)
+
+        assert topcli.main(["--registry", addr, "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "FLEET TELEMETRY" in out
+        for inst in ("proxy-0", "proxy-1", "sched-0"):
+            assert inst in out
+        assert "live" in out and "AGGREGATES" in out
+        assert "2.00/s" in out              # (100+20)/60s fleet rpc rate
+
+        assert topcli.main(["--registry", addr, "--fleet", "--json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        panels = {p["label"]: p for p in snap["panels"]}
+        assert panels["pending pods"]["value"] == 5.0
+        assert abs(panels["rpc rate"]["value"] - 2.0) < 1e-9
+        # fleet p50 pools both proxies' windowed bucket increases:
+        # 60 of 120 events sit in the first (≤10ms) bucket
+        assert 0 < panels["rpc p50"]["value"] <= 0.01
+        by_inst = {i["instance"]: i for i in snap["instances"]}
+        assert abs(by_inst["proxy-0"]["rpc_rate"] - 100 / 60) < 1e-6
+        assert abs(by_inst["proxy-1"]["rpc_rate"] - 20 / 60) < 1e-6
+        assert by_inst["sched-0"]["rpc_rate"] is None
+        assert not by_inst["proxy-0"]["stale"]
+    finally:
+        srv.shutdown()
+
+
+def test_cli_fleet_empty_registry_degrades(capsys):
+    reg, srv, _ = serve_fleet()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        assert topcli.main(["--registry", addr, "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "no instances have pushed" in out
+    finally:
+        srv.shutdown()
+
+
+def test_cli_critpath_over_sim_spans(tmp_path, capsys):
+    from kubeshare_tpu.sim.simulator import simulate_critpath
+
+    spans = tmp_path / "spans"
+    simulate_critpath(8, seed=1, spans_dir=str(spans))
+    assert topcli.main(["--critpath", "--spans", str(spans)]) == 0
+    out = capsys.readouterr().out
+    assert "critical path" in out and "8 trace(s)" in out
+    assert "execute" in out and "queue-wait" in out
+
+    assert topcli.main(["--critpath", "--spans", str(spans),
+                        "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)["report"]
+    assert rep["coverage_min"] >= 0.95 and len(rep["sources"]) >= 3
+
+    # no span files at all: loud exit 2
+    assert topcli.main(["--critpath"]) == 2
+    assert "--spans" in capsys.readouterr().err
+
+
+def test_latency_windowed_quantiles_survive_counter_reset():
+    """Regression: --latency --watch used to estimate quantiles from the
+    raw cumulative buckets; a proxy restart made the buckets go BACKWARDS
+    and the deltas negative. The TSDB-backed path must report the
+    post-restart window truthfully."""
+    from kubeshare_tpu.obs.tsdb import TimeSeriesStore
+
+    def expo(per_le, total):
+        lines = ["# TYPE kubeshare_x_seconds histogram"]
+        for le, c in per_le.items():
+            lines.append('kubeshare_x_seconds_bucket{le="%s"} %d' % (le, c))
+        lines.append("kubeshare_x_seconds_sum 0.5")
+        lines.append("kubeshare_x_seconds_count %d" % total)
+        return "\n".join(lines) + "\n"
+
+    store = TimeSeriesStore()
+    latency_kw = dict(store=store, window_s=60.0)
+    topcli.latency_snapshot(
+        expo({"0.05": 8, "0.1": 10, "+Inf": 10}, 10), now=100.0,
+        **latency_kw)
+    # process restarted: cumulative count dropped 10 -> 3
+    lat = topcli.latency_snapshot(
+        expo({"0.05": 1, "0.1": 3, "+Inf": 3}, 3), now=110.0, **latency_kw)
+    assert lat["windowed_s"] == 60.0
+    row = next(h for h in lat["histograms"]
+               if h["family"] == "kubeshare_x_seconds")
+    assert row["count"] == 3                 # full post-reset value
+    assert row["p50"] == row["p50"]          # not NaN
+    assert 0 < row["p50"] <= 0.1 and row["p99"] >= 0
+
+
 def test_cli_serving_unreachable_scheduler_degrades(capsys):
     reg, srv, _ = serve_fleet()
     addr = f"127.0.0.1:{srv.server_address[1]}"

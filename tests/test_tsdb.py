@@ -1,0 +1,187 @@
+"""Fleet TSDB: the bounded store behind the registry's GET /query.
+
+Everything runs on explicit ``now`` — the same virtual-time discipline
+the sim uses — so windows, staleness, and tier boundaries are exact.
+"""
+
+import math
+
+from kubeshare_tpu.obs.tsdb import TimeSeriesStore
+
+
+def counter_snap(value, name="kubeshare_rpc_total", labels=None):
+    return {"families": {name: "counter"},
+            "samples": [(name, labels or {}, float(value))]}
+
+
+def gauge_snap(value, name="kubeshare_pending", labels=None):
+    return {"families": {name: "gauge"},
+            "samples": [(name, labels or {}, float(value))]}
+
+
+def hist_snap(per_bucket, name="kubeshare_lat_seconds"):
+    """Cumulative buckets from per-le counts ``{le: cumulative}``."""
+    samples = [(name + "_bucket", {"le": le}, float(c))
+               for le, c in per_bucket.items()]
+    total = per_bucket["+Inf"]
+    samples += [(name + "_sum", {}, 1.0), (name + "_count", {}, total)]
+    return {"families": {name: "histogram"}, "samples": samples}
+
+
+def test_ingest_and_instant_aggregations():
+    st = TimeSeriesStore()
+    st.ingest("p0", "chipproxy", snapshot=gauge_snap(3.0), now=10.0)
+    st.ingest("p1", "chipproxy", snapshot=gauge_snap(5.0), now=11.0)
+    res = st.query("kubeshare_pending", agg="sum", window_s=60, now=12.0)
+    assert res["groups"][0]["value"] == 8.0
+    assert res["series_matched"] == 2
+    assert st.query("kubeshare_pending", agg="avg", window_s=60,
+                    now=12.0)["groups"][0]["value"] == 4.0
+    assert st.query("kubeshare_pending", agg="max", window_s=60,
+                    now=12.0)["groups"][0]["value"] == 5.0
+
+
+def test_group_by_instance_and_matchers():
+    st = TimeSeriesStore()
+    st.ingest("p0", "chipproxy", snapshot=gauge_snap(3.0), now=1.0)
+    st.ingest("p1", "chipproxy", snapshot=gauge_snap(5.0), now=1.0)
+    res = st.query("kubeshare_pending", agg="sum", window_s=60,
+                   by=("instance",), now=2.0)
+    assert [(g["labels"]["instance"], g["value"])
+            for g in res["groups"]] == [("p0", 3.0), ("p1", 5.0)]
+    res = st.query("kubeshare_pending", agg="sum", window_s=60,
+                   matchers={"instance": "p1"}, now=2.0)
+    assert res["groups"][0]["value"] == 5.0 and res["series_matched"] == 1
+
+
+def test_counter_rate_survives_reset():
+    """A proxy restart zeroes its counters mid-window; the increase
+    must count the post-reset value in full, never go negative."""
+    st = TimeSeriesStore()
+    st.ingest("p0", "chipproxy", snapshot=counter_snap(100), now=0.0)
+    st.ingest("p0", "chipproxy", snapshot=counter_snap(150), now=10.0)
+    st.ingest("p0", "chipproxy", snapshot=counter_snap(7), now=20.0)  # reset
+    st.ingest("p0", "chipproxy", snapshot=counter_snap(10), now=30.0)
+    res = st.query("kubeshare_rpc_total", agg="increase", window_s=60,
+                   now=30.0)
+    assert res["groups"][0]["value"] == 50 + 7 + 3
+    rate = st.query("kubeshare_rpc_total", agg="rate", window_s=60,
+                    now=30.0)["groups"][0]["value"]
+    assert rate == (50 + 7 + 3) / 60.0
+
+
+def test_staleness_by_silence_and_marker():
+    st = TimeSeriesStore(stale_after_s=30.0)
+    st.ingest("dead", "chipproxy", snapshot=gauge_snap(9.0), now=0.0)
+    st.ingest("live", "chipproxy", snapshot=gauge_snap(1.0), now=25.0)
+    # within stale_after both count; past it the silent one drops out
+    assert st.query("kubeshare_pending", agg="sum", window_s=60,
+                    now=29.0)["groups"][0]["value"] == 10.0
+    res = st.query("kubeshare_pending", agg="sum", window_s=60, now=40.0)
+    assert res["groups"][0]["value"] == 1.0
+    insts = {i["instance"]: i for i in st.instances(now=40.0)}
+    assert insts["dead"]["stale"] and not insts["live"]["stale"]
+    # explicit marker retires immediately; the next push revives
+    st.mark_stale("live")
+    assert st.query("kubeshare_pending", agg="sum", window_s=60,
+                    now=41.0)["groups"] == []
+    st.ingest("live", "chipproxy", snapshot=gauge_snap(2.0), now=42.0)
+    assert st.query("kubeshare_pending", agg="sum", window_s=60,
+                    now=43.0)["groups"][0]["value"] == 2.0
+
+
+def test_out_of_order_push_dropped_not_rewound():
+    st = TimeSeriesStore()
+    st.ingest("p0", "j", snapshot=gauge_snap(5.0), now=100.0)
+    assert st.ingest("p0", "j", snapshot=gauge_snap(9.0), now=50.0) == 0
+    assert st.query("kubeshare_pending", agg="latest", window_s=200,
+                    now=101.0)["groups"][0]["value"] == 5.0
+
+
+def test_downsampled_tier_serves_aged_out_history():
+    """Raw ring capacity 4; history older than the ring must still be
+    answerable from the 30s-resolution coarse tier."""
+    st = TimeSeriesStore(raw_capacity=4, tier_resolution_s=30.0,
+                        retention_s=600.0, stale_after_s=1e9)
+    for i in range(20):                       # t = 0..190, raw keeps last 4
+        st.ingest("p0", "j", snapshot=counter_snap(i * 10), now=i * 10.0)
+    # window covering only aged-out raw points: tier answers
+    res = st.query("kubeshare_rpc_total", agg="increase", window_s=190,
+                   now=190.0)
+    # tier points at 0,30,60..180 plus raw 160..190: full increase seen
+    assert res["groups"][0]["value"] == 190.0
+
+
+def test_caps_shed_stalest_series_first():
+    st = TimeSeriesStore(max_series=2)
+    st.ingest("a", "j", snapshot=gauge_snap(1.0, name="kubeshare_a"),
+              now=0.0)
+    st.ingest("b", "j", snapshot=gauge_snap(1.0, name="kubeshare_b"),
+              now=10.0)
+    st.ingest("c", "j", snapshot=gauge_snap(1.0, name="kubeshare_c"),
+              now=20.0)
+    assert st.series_count() == 2
+    fams = st.families()
+    assert "kubeshare_a" not in fams          # stalest went first
+    assert {"kubeshare_b", "kubeshare_c"} <= set(fams)
+
+
+def test_histogram_quantile_across_instances_and_reset():
+    """Quantile is computed from windowed per-le increases summed across
+    instances — a restarted instance's bucket reset cannot drive the
+    deltas negative."""
+    st = TimeSeriesStore()
+    st.ingest("p0", "j", snapshot=hist_snap({"0.1": 0, "1": 0, "+Inf": 0}),
+              now=0.0)
+    st.ingest("p1", "j",
+              snapshot=hist_snap({"0.1": 50, "1": 60, "+Inf": 60}),
+              now=0.0)
+    st.ingest("p0", "j",
+              snapshot=hist_snap({"0.1": 80, "1": 100, "+Inf": 100}),
+              now=10.0)
+    # p1 restarted: cumulative counts DROPPED — post-reset counts in full
+    st.ingest("p1", "j",
+              snapshot=hist_snap({"0.1": 10, "1": 20, "+Inf": 20}),
+              now=10.0)
+    res = st.query("kubeshare_lat_seconds", agg="quantile", q=0.5,
+                   window_s=60, now=10.0)
+    v = res["groups"][0]["value"]
+    assert v is not None and 0.0 < v <= 0.1   # 90/120 under 0.1s
+    # no in-window activity -> None (PromQL's NaN), not a stale number
+    st.ingest("p0", "j",
+              snapshot=hist_snap({"0.1": 80, "1": 100, "+Inf": 100}),
+              now=20.0)
+    res = st.query("kubeshare_lat_seconds", agg="quantile", q=0.5,
+                   window_s=9, matchers={"instance": "p0"}, now=20.0)
+    assert res["groups"][0]["value"] is None
+
+
+def test_range_query_sparkline_points():
+    st = TimeSeriesStore(stale_after_s=1e9)
+    for i in range(7):
+        st.ingest("p0", "j", snapshot=gauge_snap(float(i)), now=i * 10.0)
+    rr = st.range_query("kubeshare_pending", agg="sum", window_s=15,
+                        step_s=10.0, span_s=60.0, now=60.0)
+    values = [p["value"] for p in rr["points"]]
+    assert len(values) == 7
+    assert values[-1] == 6.0 and values[0] == 0.0
+
+
+def test_exposition_compat_path():
+    st = TimeSeriesStore()
+    text = ("# HELP kubeshare_pending x\n"
+            "# TYPE kubeshare_pending gauge\n"
+            "kubeshare_pending 4\n")
+    assert st.ingest("p0", "j", exposition=text, now=1.0) == 1
+    assert st.query("kubeshare_pending", agg="latest", window_s=60,
+                    now=2.0)["groups"][0]["value"] == 4.0
+
+
+def test_stats_and_bytes_accounting():
+    st = TimeSeriesStore()
+    st.ingest("p0", "j", snapshot=gauge_snap(1.0), now=0.0)
+    s = st.stats()
+    assert s["series"] == 1 and s["pushes"] == 1
+    assert s["samples_ingested"] == 1 and s["instances"] == 1
+    assert s["bytes_estimate"] > 0
+    assert not math.isinf(st.bytes_estimate())
