@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos image clean obs-check
 
 all: native
 
@@ -106,6 +106,14 @@ bench-serving:
 bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_fleet.py --check \
 		--baseline bench_fleet.json --write bench_fleet.json
+
+# Chaos-plane bench (doc/chaos.md): the deterministic multi-fault
+# scenario suite across >= 3 seeds in virtual time; --check gates
+# zero invariant violations, full reconvergence and the per-scenario
+# MTTR roof, then refreshes bench_chaos.json.
+bench-chaos:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_chaos.py --check \
+		--baseline bench_chaos.json --write bench_chaos.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

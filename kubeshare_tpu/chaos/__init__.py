@@ -1,0 +1,19 @@
+"""Chaos plane: deterministic multi-fault orchestration (doc/chaos.md).
+
+Jepsen-style nemesis over the seeded fault injectors: scenario
+schedules (:mod:`.scenarios`), a cluster-invariant oracle
+(:mod:`.invariants`), and the virtual-time runner + MTTR stopwatch
+(:mod:`.orchestrator`).  Entry points: ``sim --chaos``,
+``make bench-chaos``, CI's chaos-matrix job.
+"""
+
+from .invariants import check_cluster, violation
+from .orchestrator import (ChaosRunner, run_matrix, run_scenario,
+                           run_suite)
+from .scenarios import BUILDERS, ChaosAction, Scenario, all_scenarios, build
+
+__all__ = [
+    "BUILDERS", "ChaosAction", "ChaosRunner", "Scenario",
+    "all_scenarios", "build", "check_cluster", "run_matrix",
+    "run_scenario", "run_suite", "violation",
+]

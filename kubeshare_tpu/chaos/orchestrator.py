@@ -1,0 +1,476 @@
+"""Deterministic chaos orchestration: nemesis + oracle + stopwatch.
+
+:class:`ChaosRunner` replays a :class:`~.scenarios.Scenario` against a
+*real* control plane — engine, dispatcher, registry (with an on-disk
+journal), autopilot, serving front door + batcher — advanced on a
+virtual clock in fixed ticks, so the same ``(scenario, seed)`` always
+produces the identical timeline, invariant samples, and MTTR.  This is
+the engine behind ``sim --chaos``, ``make bench-chaos``, and CI's
+chaos-matrix job (doc/chaos.md).
+
+Per scenario the runner:
+
+1. executes the fault timeline, stepping the dispatcher and batcher on
+   every tick and sampling the invariant catalog between fault windows;
+2. after the last fault, drives the cluster until it **reconverges**
+   (no pending/parked pods, serving queues drained, invariants clean)
+   or the scenario's ``converge_bound_s`` expires;
+3. records MTTR = convergence time − fault-window end, plus every
+   invariant violation with its virtual timestamp.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from collections import deque
+
+from . import invariants
+from .scenarios import ChaosAction, Scenario, all_scenarios, build
+
+#: virtual-time step; every plane is advanced once per tick
+TICK_S = 0.05
+#: invariant sampling period during fault windows
+SAMPLE_EVERY_S = 0.5
+#: heartbeat period for the synthetic node agents
+LEASE_EVERY_S = 0.5
+
+
+class _PartitionedRegistry:
+    """Registry wrapper the dispatcher publishes through: while the
+    partition window is open every call fails with ``OSError`` — the
+    same face a real partition shows ``RegistryClient`` — so binding
+    publishes exercise their rollback path."""
+
+    def __init__(self, runner):
+        self._runner = runner
+
+    def __getattr__(self, name):
+        inner = getattr(self._runner.registry, name)
+        if not callable(inner):
+            return inner
+
+        def call(*a, **kw):
+            if self._runner.partitioned():
+                raise OSError("chaos: registry partitioned")
+            return inner(*a, **kw)
+
+        return call
+
+
+class _CrashableServable:
+    """LocalServable that hard-fails inside the crash window — the
+    virtual-time stand-in for a proxy ``kill -9`` mid-batch.  Riders
+    must fail loudly and stay accounted (serving-exactly-once)."""
+
+    batch_size = 8
+
+    def __init__(self, runner):
+        self._runner = runner
+        self.crashed_until = -1.0
+
+    def execute(self, x):
+        if self._runner.now < self.crashed_until:
+            raise ConnectionResetError("chaos: servable crashed")
+        return x * 2.0
+
+    def close(self):
+        pass
+
+
+class ChaosRunner:
+    """One scenario run over a real in-process control plane."""
+
+    def __init__(self, seed: int = 0, workdir: str | None = None,
+                 hosts: int = 2, mesh: tuple = (2, 2)):
+        from ..scheduler import SchedulerEngine
+        from ..scheduler.dispatcher import Dispatcher
+        from ..serving.batcher import ContinuousBatcher
+        from ..serving.frontdoor import FrontDoor
+        from ..telemetry.registry import TelemetryRegistry
+        from ..topology.discovery import FakeTopology
+
+        self.seed = int(seed)
+        self.now = 0.0
+        if workdir is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="chaos-")
+            workdir = self._tmp.name
+        self.workdir = workdir
+        self.registry_journal = os.path.join(workdir, "registry.jsonl")
+        self.autopilot_journal = os.path.join(workdir, "autopilot.jsonl")
+        self.registry = TelemetryRegistry(journal=self.registry_journal,
+                                          clock=self._clock)
+        self._partition_until = -1.0
+        self.engine = SchedulerEngine(clock=self._clock)
+        by_host: dict = {}
+        for chip in FakeTopology(hosts=hosts, mesh=mesh).chips():
+            by_host.setdefault(chip.host, []).append(chip)
+        self.nodes = sorted(by_host)
+        for host, chips in sorted(by_host.items()):
+            self.engine.add_node(host, chips)
+        self.disp = Dispatcher(self.engine,
+                               registry=_PartitionedRegistry(self),
+                               clock=self._clock)
+        self.fd = FrontDoor(clock=self._clock)
+        self.servable = _CrashableServable(self)
+        self.batcher = ContinuousBatcher(self.fd, self.servable,
+                                         max_wait_s=0.05,
+                                         clock=self._clock)
+        self.autopilot = None
+        self.token_scheds: dict = {}
+        self.parked: dict[str, dict] = {}        # tenant -> manifest
+        self._serve_results: list = []
+        self._lease_epoch = 0
+        self._next_lease = 0.0
+        self._deferred: deque = deque()          # flap-expanded actions
+        self.timeline: list[dict] = []
+        self.violations: list[dict] = []
+        self.samples = 0
+
+    # -- clocks + fault state -------------------------------------------
+
+    def _clock(self) -> float:
+        return self.now
+
+    def partitioned(self) -> bool:
+        return self.now < self._partition_until
+
+    # -- action execution -----------------------------------------------
+
+    def _note(self, action: ChaosAction) -> None:
+        self.timeline.append(dict(action.to_dict(),
+                                  applied_at=round(self.now, 3)))
+
+    def _apply(self, act: ChaosAction) -> None:
+        from kubeshare_tpu import constants as C
+
+        self._note(act)
+        p = act.params
+        if act.action == "submit":
+            prefix = p.get("prefix", "pod")
+            labels = {C.POD_TPU_REQUEST: str(p.get("request", 0.5)),
+                      C.POD_TPU_LIMIT: "1.0"}
+            for i in range(int(p.get("count", 1))):
+                self.disp.submit("chaos", f"{prefix}{i}", dict(labels))
+        elif act.action == "submit_gang":
+            labels = {C.POD_TPU_REQUEST: str(p.get("request", 0.5)),
+                      C.POD_TPU_LIMIT: "1.0",
+                      C.POD_GROUP_NAME: p["name"],
+                      C.POD_GROUP_HEADCOUNT: str(p["headcount"]),
+                      C.POD_GROUP_THRESHOLD: "1.0"}
+            for i in range(int(p["headcount"])):
+                self.disp.submit("chaos", f"{p['name']}-{i}", dict(labels))
+        elif act.action == "delete_prefix":
+            with self.disp.lock:
+                keys = [k for k, pod in self.engine.pod_status.items()
+                        if pod.name.startswith(act.target)]
+            for k in keys:
+                self.disp.delete(k)
+        elif act.action == "node_down":
+            with self.disp.lock:
+                self.engine.veto_health(act.target, True)
+                self.engine.set_node_health(act.target, False)
+            self.disp.evict_node(act.target, self.now,
+                                 reason="chaos: node down")
+        elif act.action == "node_up":
+            with self.disp.lock:
+                self.engine.veto_health(act.target, False)
+                self.engine.set_node_health(act.target, True)
+        elif act.action == "flap":
+            period = float(p.get("period_s", 0.5))
+            at = act.at_s
+            for i in range(int(p.get("count", 3))):
+                self._deferred.append(ChaosAction(
+                    at + (2 * i) * period, "node_down", act.target))
+                self._deferred.append(ChaosAction(
+                    at + (2 * i + 1) * period, "node_up", act.target))
+        elif act.action == "registry_restart":
+            self._restart_registry()
+        elif act.action == "registry_partition":
+            self._partition_until = self.now + float(
+                p.get("duration_s", 1.0))
+        elif act.action == "autopilot_apply":
+            self._autopilot_cycle()
+        elif act.action == "serve_submit":
+            self._serve_submit(p.get("tenant", "t0"),
+                               int(p.get("count", 1)))
+        elif act.action == "servable_crash":
+            self.servable.crashed_until = self.now + float(
+                p.get("duration_s", 1.0))
+        elif act.action == "park":
+            manifest = self.fd.park(act.target)
+            self.parked[act.target] = manifest
+        elif act.action == "resume":
+            manifest = self.parked.pop(act.target, None)
+            if manifest is not None:
+                self.fd.resume(manifest, now=self.now)
+        else:
+            raise ValueError(f"unknown chaos action {act.action!r}")
+
+    def _restart_registry(self) -> None:
+        from ..telemetry.registry import TelemetryRegistry
+
+        if self.registry._journal is not None:
+            self.registry._journal.close()   # flush before the "restart"
+        self.violations.extend(
+            dict(v, at_s=round(self.now, 3)) for v in
+            invariants.check_registry_replay_idempotent(
+                self.registry_journal))
+        self.registry = TelemetryRegistry(journal=self.registry_journal,
+                                          clock=self._clock)
+
+    def _autopilot_cycle(self) -> None:
+        if self.autopilot is None:
+            from ..autopilot import Autopilot, Planner, Rebalancer
+
+            planner = Planner(self.disp, budget=8, min_improvement=0.01,
+                              cooldown_s=30.0, clock=self._clock)
+            reb = Rebalancer(self.disp, planner=planner,
+                             journal_path=self.autopilot_journal,
+                             clock=self._clock)
+            self.autopilot = Autopilot(self.disp, planner=planner,
+                                       rebalancer=reb,
+                                       clock=self._clock)
+        self.autopilot.cycle(now=self.now)
+
+    def _serve_submit(self, tenant: str, count: int) -> None:
+        import numpy as np
+
+        from ..serving.frontdoor import Overloaded
+
+        if tenant not in self.fd._tenants and tenant not in self.parked:
+            self.fd.register_tenant(tenant, "latency")
+        x = np.ones((1, 4), dtype=np.float32)
+        for _ in range(count):
+            try:
+                self._serve_results.append(
+                    self.fd.submit(tenant, x, tpu_class="latency"))
+            except Overloaded:
+                pass       # shed loudly == accounted, not a violation
+
+    # -- token-share mirror ---------------------------------------------
+
+    def _sync_token_scheds(self) -> None:
+        """Mirror engine bookings into real per-chip TokenSchedulers so
+        the token-shares invariant is checked against the actual
+        accounting code, not a re-derivation."""
+        from ..isolation.tokensched import TokenScheduler
+
+        with self.disp.lock:
+            want: dict[str, dict[str, float]] = {}
+            for pod in self.engine.pod_status.values():
+                for chip_id, compute, _mem in getattr(pod, "bookings", ()):
+                    want.setdefault(chip_id, {})[pod.key] = compute
+        for chip_id, clients in want.items():
+            sched = self.token_scheds.get(chip_id)
+            if sched is None:
+                sched = TokenScheduler(native=False, clock=self._clock,
+                                       chip=chip_id)
+                self.token_scheds[chip_id] = sched
+            have = sched.shares()
+            for name in list(have):
+                if name not in clients:
+                    sched.remove_client(name)
+            for name, req in clients.items():
+                if name not in have:
+                    sched.add_client(name, min(req, 1.0), 1.0)
+        for chip_id in list(self.token_scheds):
+            if chip_id not in want:
+                del self.token_scheds[chip_id]
+
+    # -- invariant sampling ---------------------------------------------
+
+    def _parked_pending(self) -> int:
+        return sum(len(m.get("pending", ()))
+                   for m in self.parked.values())
+
+    def _sample(self, where: str, journals: bool = False) -> list[dict]:
+        self.samples += 1
+        self._sync_token_scheds()
+        with self.disp.lock:
+            in_flight = (set(self.disp._pending)
+                         | set(self.disp._parked))
+            found = invariants.check_engine(self.engine, in_flight)
+        found.extend(invariants.check_token_shares(self.token_scheds))
+        found.extend(invariants.check_serving_exactly_once(
+            self.fd, self._parked_pending()))
+        if journals:
+            found.extend(invariants.check_registry_replay_idempotent(
+                self.registry_journal))
+            found.extend(invariants.check_autopilot_journal_idempotent(
+                self.autopilot_journal))
+        stamped = [dict(v, at_s=round(self.now, 3), where=where)
+                   for v in found]
+        self.violations.extend(stamped)
+        return stamped
+
+    # -- the loop ---------------------------------------------------------
+
+    def _tick(self) -> None:
+        if self.now >= self._next_lease:
+            self._lease_epoch += 1
+            for node in self.nodes:
+                if self.engine.node_health.get(node, False):
+                    try:
+                        self.registry.put_lease(node, self._lease_epoch,
+                                                ttl_s=3.0)
+                    except OSError:
+                        pass            # partitioned — the point
+            self._next_lease = self.now + LEASE_EVERY_S
+        self.disp.step(self.now)
+        self.batcher.step(self.now)
+
+    def _converged(self) -> bool:
+        if self.partitioned() or self.now < self.servable.crashed_until:
+            return False
+        with self.disp.lock:
+            if self.disp._pending or self.disp._parked:
+                return False
+        with self.fd.lock:
+            if any(t.queue for t in self.fd._tenants.values()):
+                return False
+        return True
+
+    def run(self, scenario: Scenario) -> dict:
+        pending = deque(sorted(scenario.actions, key=lambda a: a.at_s))
+        window_end = scenario.fault_window_end_s
+        next_sample = SAMPLE_EVERY_S
+        while pending or self._deferred or self.now <= window_end:
+            while pending and pending[0].at_s <= self.now:
+                self._apply(pending.popleft())
+            self._deferred = deque(sorted(self._deferred,
+                                          key=lambda a: a.at_s))
+            while self._deferred and self._deferred[0].at_s <= self.now:
+                act = self._deferred.popleft()
+                self._note(act)
+                window_end = max(window_end, act.at_s)
+                if act.action == "node_down":
+                    with self.disp.lock:
+                        self.engine.veto_health(act.target, True)
+                        self.engine.set_node_health(act.target, False)
+                    self.disp.evict_node(act.target, self.now,
+                                         reason="chaos: flap down")
+                else:
+                    with self.disp.lock:
+                        self.engine.veto_health(act.target, False)
+                        self.engine.set_node_health(act.target, True)
+            self._tick()
+            if self.now >= next_sample:
+                self._sample("window")
+                next_sample = self.now + SAMPLE_EVERY_S
+            self.now = round(self.now + TICK_S, 6)
+        # -- recovery verification ------------------------------------
+        window_end = max(window_end, self._partition_until,
+                         self.servable.crashed_until)
+        converged_at = None
+        deadline = window_end + scenario.converge_bound_s
+        while self.now <= deadline:
+            self._tick()
+            self.batcher.flush(self.now)
+            if self._converged():
+                fresh = self._sample("convergence", journals=True)
+                if not fresh:
+                    converged_at = self.now
+                    break
+            self.now = round(self.now + TICK_S, 6)
+        mttr = (max(0.0, converged_at - window_end)
+                if converged_at is not None else None)
+        if converged_at is None:
+            self.violations.append(invariants.violation(
+                "reconvergence",
+                f"{scenario.name}: not converged within "
+                f"{scenario.converge_bound_s:g}s of the fault window",
+                at_s=round(self.now, 3)))
+        return {
+            "scenario": scenario.name,
+            "seed": self.seed,
+            "converged": converged_at is not None,
+            "mttr_s": round(mttr, 3) if mttr is not None else None,
+            "fault_window_end_s": round(window_end, 3),
+            "samples": self.samples,
+            "violations": self.violations,
+            "timeline": self.timeline,
+        }
+
+    def close(self) -> None:
+        for sched in self.token_scheds.values():
+            try:
+                sched.close()
+            except Exception:
+                pass
+        tmp = getattr(self, "_tmp", None)
+        if tmp is not None:
+            tmp.cleanup()
+
+
+# -- suite entry points --------------------------------------------------
+
+
+def run_scenario(name: str, seed: int = 0,
+                 workdir: str | None = None) -> dict:
+    runner = ChaosRunner(seed=seed, workdir=workdir)
+    try:
+        return runner.run(build(name, seed))
+    finally:
+        runner.close()
+
+
+def run_suite(seed: int = 0, names: list | None = None) -> dict:
+    """Run every scenario on one seed — the ``sim --chaos`` body."""
+    scenarios = ([build(n, seed) for n in names] if names
+                 else all_scenarios(seed))
+    results = []
+    for scn in scenarios:
+        runner = ChaosRunner(seed=seed)
+        try:
+            results.append(runner.run(scn))
+        finally:
+            runner.close()
+    return {
+        "seed": seed,
+        "scenarios": results,
+        "invariant_violations": sum(len(r["violations"])
+                                    for r in results),
+        "converged": all(r["converged"] for r in results),
+    }
+
+
+def _percentile(values: list, q: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+    return vals[idx]
+
+
+def run_matrix(seeds: list, names: list | None = None) -> dict:
+    """Multi-seed aggregation — the ``bench-chaos`` body: per-scenario
+    MTTR p50/p99 across seeds plus the zero-violation gate."""
+    per_scenario: dict[str, dict] = {}
+    total_violations = 0
+    for seed in seeds:
+        suite = run_suite(seed, names)
+        total_violations += suite["invariant_violations"]
+        for res in suite["scenarios"]:
+            agg = per_scenario.setdefault(
+                res["scenario"],
+                {"mttr_samples_s": [], "violations": 0,
+                 "converged": True})
+            if res["mttr_s"] is not None:
+                agg["mttr_samples_s"].append(res["mttr_s"])
+            agg["violations"] += len(res["violations"])
+            agg["converged"] = agg["converged"] and res["converged"]
+    scenarios = {}
+    for name, agg in sorted(per_scenario.items()):
+        samples = agg.pop("mttr_samples_s")
+        scenarios[name] = dict(
+            agg,
+            mttr_p50_s=round(_percentile(samples, 0.50), 3),
+            mttr_p99_s=round(_percentile(samples, 0.99), 3),
+            runs=len(seeds))
+    return {
+        "seeds": list(seeds),
+        "scenarios": scenarios,
+        "invariant_violations": total_violations,
+        "converged": all(s["converged"] for s in scenarios.values()),
+    }

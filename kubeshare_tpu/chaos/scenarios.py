@@ -1,0 +1,220 @@
+"""Seeded, declarative chaos scenarios — the nemesis schedule.
+
+A :class:`Scenario` is a timeline of :class:`ChaosAction` records in
+*virtual seconds*; the orchestrator replays the same timeline in the
+``sim --chaos`` virtual-time loop and (for the transport scenarios)
+against the real socket stack.  Timelines are deterministic per
+``(name, seed)``: jitter comes from a ``random.Random`` keyed on both,
+so the same seed always yields the identical schedule, report, and
+MTTR samples — the acceptance bar CI's chaos-matrix gates on.
+
+Action vocabulary (executed by ``orchestrator.ChaosRunner``):
+
+``submit``            enqueue fractional pods (params: count, request)
+``submit_gang``       enqueue one gang (params: name, headcount, request)
+``node_down``         lose a node: health veto + eviction
+``node_up``           node returns healthy
+``flap``              heartbeat flap: N down/up toggles (params: count,
+                      period_s) — the detector must not thrash
+``registry_restart``  rebuild the registry from its journal mid-lease
+                      and assert replay idempotency
+``registry_partition`` registry writes fail for the window (params:
+                      duration_s) — binding publishes must roll back
+``autopilot_apply``   run one plan+apply cycle (races whatever else is
+                      in the window)
+``serve_submit``      admit serving requests (params: tenant, count)
+``park`` / ``resume`` freeze a serving tenant into a manifest / replay it
+``servable_crash``    the shared servable raises for the window (params:
+                      duration_s) — riders must fail loudly, never hang
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ChaosAction:
+    at_s: float
+    action: str
+    target: str = ""
+    params: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"at_s": round(self.at_s, 3), "action": self.action,
+                "target": self.target, "params": dict(self.params)}
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str
+    actions: list
+    #: recovery bound: the cluster must reconverge within this many
+    #: virtual seconds of the last fault action (recovery verifier)
+    converge_bound_s: float = 60.0
+
+    @property
+    def fault_window_end_s(self) -> float:
+        return max((a.at_s for a in self.actions), default=0.0)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name,
+                "actions": [a.to_dict() for a in self.actions],
+                "converge_bound_s": self.converge_bound_s}
+
+
+def _rng(name: str, seed: int) -> random.Random:
+    """Deterministic per (name, seed) — crc32, not hash(): str hashing
+    is salted per process and would break cross-run determinism."""
+    return random.Random((zlib.crc32(name.encode()) << 16) ^ (seed & 0xffff)
+                         ^ (seed << 40))
+
+
+def _j(rng: random.Random, base: float, spread: float = 0.3) -> float:
+    """Jitter a timestamp: base + U[0, spread) virtual seconds."""
+    return base + rng.random() * spread
+
+
+# -- the six composite scenarios ----------------------------------------
+
+
+def node_crash_flap(seed: int) -> Scenario:
+    """A node dies while another node's heartbeat flaps — eviction and
+    the flap damper must not fight (doc/health.md)."""
+    r = _rng("node-crash-flap", seed)
+    return Scenario(
+        "node-crash-flap",
+        "node crash + heartbeat flap on a second node",
+        [
+            ChaosAction(0.0, "submit", params={"count": 6, "request": 0.5}),
+            ChaosAction(_j(r, 1.0), "node_down", "host-0"),
+            ChaosAction(_j(r, 1.2), "flap", "host-1",
+                        {"count": 3, "period_s": round(
+                            0.4 + r.random() * 0.4, 3)}),
+            ChaosAction(_j(r, 6.0), "node_up", "host-0"),
+        ])
+
+
+def registry_restart_mid_lease(seed: int) -> Scenario:
+    """The registry restarts from its journal while leases are live and
+    bindings are being published — replay must be idempotent."""
+    r = _rng("registry-restart-mid-lease", seed)
+    return Scenario(
+        "registry-restart-mid-lease",
+        "registry journal restart while leases + bindings are live",
+        [
+            ChaosAction(0.0, "submit", params={"count": 5, "request": 0.4}),
+            ChaosAction(_j(r, 1.0), "registry_restart"),
+            ChaosAction(_j(r, 1.5), "submit",
+                        params={"count": 3, "request": 0.4,
+                                "prefix": "late"}),
+            ChaosAction(_j(r, 2.5), "registry_restart"),
+        ])
+
+
+def proxy_kill_windowed_put(seed: int) -> Scenario:
+    """The execution backend dies mid-window.  In virtual time the
+    shared servable crashes for a window (riders must fail loudly —
+    exactly-once); the live variant (tests/test_chaos.py) drives a real
+    ChipProxy ``crash()`` during a chunked put and checks HBM
+    conservation across journal recovery."""
+    r = _rng("proxy-kill-windowed-put", seed)
+    crash_at = _j(r, 1.0)
+    return Scenario(
+        "proxy-kill-windowed-put",
+        "backend killed mid-put; riders fail loudly, HBM conserved",
+        [
+            ChaosAction(0.0, "serve_submit",
+                        params={"tenant": "t-put", "count": 4}),
+            ChaosAction(crash_at, "servable_crash",
+                        params={"duration_s": round(
+                            1.0 + r.random() * 0.5, 3)}),
+            ChaosAction(_j(r, crash_at + 0.1, 0.2), "serve_submit",
+                        params={"tenant": "t-put", "count": 4}),
+        ])
+
+
+def autopilot_vs_eviction(seed: int) -> Scenario:
+    """An autopilot apply batch races a node eviction — rollback rails
+    and the journal must keep moves atomic, no double-move."""
+    r = _rng("autopilot-vs-eviction", seed)
+    return Scenario(
+        "autopilot-vs-eviction",
+        "autopilot apply racing a node eviction",
+        [
+            ChaosAction(0.0, "submit", params={"count": 8, "request": 0.6}),
+            ChaosAction(0.2, "submit",
+                        params={"count": 8, "request": 0.4, "prefix": "b"}),
+            # delete the 0.6 wave -> fragmentation the planner will chase
+            ChaosAction(0.4, "delete_prefix", "pod"),
+            ChaosAction(_j(r, 1.0), "autopilot_apply"),
+            ChaosAction(_j(r, 1.05, 0.1), "node_down", "host-1"),
+            ChaosAction(_j(r, 5.0), "node_up", "host-1"),
+        ])
+
+
+def park_during_migration(seed: int) -> Scenario:
+    """A serving tenant is parked while the cluster is mid-eviction
+    (the migration path) — the manifest must stay resumable and no
+    admitted request may vanish."""
+    r = _rng("park-during-migration", seed)
+    park_at = _j(r, 1.0)
+    return Scenario(
+        "park-during-migration",
+        "serving park during a node eviction/migration window",
+        [
+            ChaosAction(0.0, "submit", params={"count": 4, "request": 0.5}),
+            ChaosAction(0.0, "serve_submit",
+                        params={"tenant": "t-park", "count": 6}),
+            ChaosAction(park_at, "serve_submit",
+                        params={"tenant": "t-park", "count": 5}),
+            ChaosAction(park_at, "node_down", "host-0"),
+            ChaosAction(park_at + 0.01, "park", "t-park"),
+            ChaosAction(_j(r, park_at + 1.0), "resume", "t-park"),
+            ChaosAction(_j(r, park_at + 2.0), "node_up", "host-0"),
+        ])
+
+
+def partition_during_gang_bind(seed: int) -> Scenario:
+    """The registry partitions away exactly while a gang is binding —
+    publishes fail, reservations must roll back, and the gang stays
+    all-or-nothing."""
+    r = _rng("partition-during-gang-bind", seed)
+    part_at = _j(r, 0.5, 0.2)
+    return Scenario(
+        "partition-during-gang-bind",
+        "registry partition during gang bind",
+        [
+            ChaosAction(0.0, "submit", params={"count": 2, "request": 0.3}),
+            ChaosAction(part_at, "registry_partition",
+                        params={"duration_s": round(
+                            1.0 + r.random() * 0.5, 3)}),
+            ChaosAction(part_at + 0.01, "submit_gang",
+                        params={"name": "ring", "headcount": 4,
+                                "request": 0.5}),
+        ])
+
+
+BUILDERS = {
+    "node-crash-flap": node_crash_flap,
+    "registry-restart-mid-lease": registry_restart_mid_lease,
+    "proxy-kill-windowed-put": proxy_kill_windowed_put,
+    "autopilot-vs-eviction": autopilot_vs_eviction,
+    "park-during-migration": park_during_migration,
+    "partition-during-gang-bind": partition_during_gang_bind,
+}
+
+
+def build(name: str, seed: int) -> Scenario:
+    try:
+        return BUILDERS[name](seed)
+    except KeyError:
+        raise KeyError("unknown chaos scenario %r (have: %s)"
+                       % (name, ", ".join(sorted(BUILDERS)))) from None
+
+
+def all_scenarios(seed: int) -> list:
+    return [b(seed) for b in BUILDERS.values()]

@@ -242,6 +242,39 @@ def check_serving(addr: str, timeout_s: float,
         f"{state.get('batches', 0)} batch(es)")
 
 
+def check_invariants(addr: str, timeout_s: float,
+                     defaulted: bool = False) -> bool:
+    """Chaos-plane probe (doc/chaos.md): ``/invariants`` must answer
+    and report a clean catalog — a live violation (double-booked chip,
+    torn gang, serving accounting drift) is a correctness failure, not
+    a capacity problem, and always fails the doctor."""
+    if not addr or addr == "none":
+        return _result("invariants", "skip", "--scheduler none")
+    try:
+        snap = json.loads(_get(f"http://{addr}/invariants", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("invariants", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("invariants", "skip",
+                           "scheduler predates /invariants")
+        return _result("invariants", "fail", f"{addr}: {exc}")
+    violations = snap.get("violations", [])
+    if violations:
+        worst = violations[0]
+        return _result(
+            "invariants", "fail",
+            f"{len(violations)} violation(s), first: "
+            f"{worst.get('invariant')}: {worst.get('detail')}")
+    return _result(
+        "invariants", "ok",
+        f"{addr}: clean ({', '.join(snap.get('checked', []))}; "
+        f"{snap.get('bound', 0)} bound / {snap.get('pending', 0)} "
+        f"pending)")
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -485,6 +518,7 @@ def main(argv=None) -> int:
     ok &= check_autopilot(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_serving(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_slo(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_invariants(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

@@ -443,6 +443,32 @@ class ChipProxy:
                  "uploads aborted)", sess.name, sess.hbm_used,
                  len(sess.aborted_staging))
 
+    # -- accounting introspection -------------------------------------------
+
+    def hbm_accounting(self) -> dict[str, dict]:
+        """Per-session HBM double-entry: ``hbm_used`` (what ``_charge``
+        accumulated) against what is actually resident — live buffer
+        bytes plus staged-upload reservations.  ``balanced`` is the
+        chaos plane's hbm-conservation invariant (doc/chaos.md); sample
+        at quiesce — an execution in flight legitimately carries a
+        transient output charge with no buffer yet."""
+        out: dict[str, dict] = {}
+        with self._slock:
+            sessions = list(self._sessions.values())
+        for sess in sessions:
+            buffer_bytes = sum(int(getattr(buf, "nbytes", 0))
+                               for buf in sess.buffers.values())
+            staged_bytes = sum(charged for (_total, _raw, charged)
+                               in sess.staging.values())
+            out[sess.name] = {
+                "hbm_used": sess.hbm_used,
+                "buffer_bytes": buffer_bytes,
+                "staged_bytes": staged_bytes,
+                "memory_cap": sess.memory_cap,
+                "balanced": sess.hbm_used == buffer_bytes + staged_bytes,
+            }
+        return out
+
     # -- drain / crash -------------------------------------------------------
 
     def drain(self) -> None:

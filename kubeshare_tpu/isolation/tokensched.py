@@ -415,6 +415,30 @@ class TokenScheduler:
         with self._cond:
             return [n for n, q in self._waiting.items() if q]
 
+    def accounting(self) -> dict:
+        """One consistent snapshot of the share ledger — the chaos
+        plane's token-shares invariant input (doc/chaos.md): per client
+        base and effective (request, limit), plus the effective-request
+        sum that must stay <= 1.0 even under elastic lending."""
+        with self._cond:
+            clients = {
+                name: {
+                    "request": base[0], "limit": base[1],
+                    "effective_request": self._effective[name][0],
+                    "effective_limit": self._effective[name][1],
+                    "class": self._classes.get(name, "best-effort"),
+                    "holding": name in self._held_since,
+                }
+                for name, base in self._shares.items()
+            }
+            return {
+                "chip": self.chip,
+                "clients": clients,
+                "share_sum": sum(c["effective_request"]
+                                 for c in clients.values()),
+                "waiting": [n for n, q in self._waiting.items() if q],
+            }
+
     def now_ms(self) -> float:
         """This scheduler's clock (injectable in tests) — the timebase
         window_usage is measured on."""

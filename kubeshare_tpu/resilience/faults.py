@@ -32,7 +32,24 @@ Control-plane injectors (the health plane's drill switchboard,
   healthwatch's quarantine exists for;
 - ``partition_registry_ops=N`` — the next ``N`` RegistryClient HTTP
   attempts fail with a transport error (a network partition between
-  this process and the registry; retries burn through the budget).
+  this process and the registry; retries burn through the budget);
+- ``drop_service_ops=N`` — the next ``N`` scheduler ``ServiceClient``
+  HTTP attempts fail with a transport error (a scheduler-service
+  restart/partition as seen by the bridge; the client's jittered
+  retries burn through the budget) — the chaos plane's cross-plane
+  trigger (doc/chaos.md).
+
+Composition (the chaos plane, ``doc/chaos.md``): a scenario injects
+*several* faults at once — a node crash **and** a heartbeat flap, a
+registry partition **during** a windowed put. :func:`compose` wraps
+any number of per-spec :class:`Injector` s into one
+:class:`CompositeInjector` implementing the same hook protocol: every
+sub-injector is consulted on every hook call (so each spec's counters
+advance deterministically regardless of its siblings), boolean
+decisions OR together and writer delays add. ``KUBESHARE_FAULTS``
+accepts the same composition as ``;``-separated spec groups, each with
+its own optional ``seed=`` (unseeded groups derive ``base_seed + index``
+so two identical specs never share a random stream).
 
 Injectors hold no references into the transport (this module imports
 nothing from ``isolation`` — the dependency points the other way), and
@@ -82,6 +99,10 @@ class FaultSpec:
     #: fail the next N RegistryClient HTTP attempts with a transport
     #: error (0 disables).
     partition_registry_ops: int = 0
+    #: fail the next N scheduler ServiceClient HTTP attempts with a
+    #: transport error (0 disables) — the bridge-side partition the
+    #: chaos plane drills (doc/chaos.md).
+    drop_service_ops: int = 0
     #: seed for any randomized decision; fixed default keeps unseeded
     #: runs reproducible too.
     seed: int = 0
@@ -106,6 +127,7 @@ class Injector:
         self._dropped = False
         self._beats: dict[str, int] = {}     # per-node heartbeat count
         self._partitioned = 0                # registry ops failed so far
+        self._service_dropped = 0            # service ops failed so far
 
     # -- client connection: frames sent ---------------------------------
 
@@ -180,6 +202,18 @@ class Injector:
             self._partitioned += 1
             return True
 
+    def should_drop_service_call(self) -> bool:
+        """Called per scheduler ServiceClient HTTP attempt; True → the
+        attempt must fail as if the connection was refused."""
+        spec = self.spec
+        if not spec.drop_service_ops:
+            return False
+        with self._mu:
+            if self._service_dropped >= spec.drop_service_ops:
+                return False
+            self._service_dropped += 1
+            return True
+
     # -- proxy worker ----------------------------------------------------
 
     def should_crash_proxy(self) -> bool:
@@ -193,11 +227,75 @@ class Injector:
             return self._chunks == spec.crash_proxy_after_chunks
 
 
-_active: Injector | None = None
+class CompositeInjector:
+    """Several simultaneous fault specs behind one hook protocol.
+
+    Every sub-injector is consulted on every hook call — each spec's
+    counters advance as if it were installed alone, so composing spec A
+    with spec B never shifts A's kill points (the property the chaos
+    scenarios and the CI fault-matrix both lean on). Boolean decisions
+    OR together; writer delays add.
+    """
+
+    def __init__(self, injectors):
+        self.injectors: list[Injector] = list(injectors)
+
+    @property
+    def specs(self) -> list[FaultSpec]:
+        return [inj.spec for inj in self.injectors]
+
+    def _any(self, method: str, *args) -> bool:
+        # consult EVERY sub-injector (no short-circuit): the decision
+        # counters must advance identically whether or not a sibling
+        # already fired this call
+        fired = False
+        for inj in self.injectors:
+            fired = getattr(inj, method)(*args) or fired
+        return fired
+
+    def should_kill_connection(self, tag: str, nframes: int) -> bool:
+        return self._any("should_kill_connection", tag, nframes)
+
+    def should_drop_reply(self, seq) -> bool:
+        return self._any("should_drop_reply", seq)
+
+    def writer_delay_s(self) -> float:
+        return sum(inj.writer_delay_s() for inj in self.injectors)
+
+    def should_suppress_heartbeat(self, node: str) -> bool:
+        return self._any("should_suppress_heartbeat", node)
+
+    def should_partition_registry(self) -> bool:
+        return self._any("should_partition_registry")
+
+    def should_drop_service_call(self) -> bool:
+        return self._any("should_drop_service_call")
+
+    def should_crash_proxy(self) -> bool:
+        return self._any("should_crash_proxy")
+
+
+def compose(*parts) -> "Injector | CompositeInjector | None":
+    """Build one injector from specs and/or injectors. One part passes
+    through unwrapped (an ``Injector`` composed alone IS that injector —
+    single-spec callers see identical behavior); several wrap into a
+    :class:`CompositeInjector`."""
+    injectors = [p if isinstance(p, (Injector, CompositeInjector))
+                 else Injector(p) for p in parts]
+    flat: list = []
+    for inj in injectors:
+        flat.extend(inj.injectors if isinstance(inj, CompositeInjector)
+                    else [inj])
+    if not flat:
+        return None
+    return flat[0] if len(flat) == 1 else CompositeInjector(flat)
+
+
+_active: Injector | CompositeInjector | None = None
 _install_mu = threading.Lock()
 
 
-def install(injector: Injector | None) -> None:
+def install(injector: "Injector | CompositeInjector | None") -> None:
     """Install (or clear, with None) the process-wide injector."""
     global _active
     with _install_mu:
@@ -208,21 +306,16 @@ def uninstall() -> None:
     install(None)
 
 
-def active() -> Injector | None:
+def active() -> "Injector | CompositeInjector | None":
     """The installed injector, or None. The hot-path check is one global
     read — with no injector installed the hooks cost nothing measurable."""
     return _active
 
 
-def from_env(environ=None) -> Injector | None:
-    """Build an injector from ``KUBESHARE_FAULTS`` (comma-separated
-    ``key=value`` pairs matching :class:`FaultSpec` fields, e.g.
-    ``kill_conn_after_frames=5,drop_reply_seq=3``) and
-    ``KUBESHARE_FAULT_SEED``. Returns None when unset."""
-    env = os.environ if environ is None else environ
-    raw = env.get("KUBESHARE_FAULTS", "").strip()
-    if not raw:
-        return None
+def parse_spec(raw: str, default_seed: int = 0) -> FaultSpec:
+    """One spec group: comma-separated ``key=value`` pairs matching
+    :class:`FaultSpec` fields, e.g. ``kill_conn_after_frames=5,
+    drop_reply_seq=3``."""
     kwargs: dict = {}
     for item in raw.split(","):
         item = item.strip()
@@ -238,10 +331,29 @@ def from_env(environ=None) -> Injector | None:
         elif key in ("kill_conn_after_frames", "kill_conn_repeat",
                      "drop_reply_seq", "crash_proxy_after_chunks", "seed",
                      "suppress_heartbeats_after", "flap_beats",
-                     "partition_registry_ops"):
+                     "partition_registry_ops", "drop_service_ops"):
             kwargs[key] = int(value)
         else:
             raise ValueError(f"unknown fault field {key!r}")
-    if "seed" not in kwargs:
-        kwargs["seed"] = int(env.get("KUBESHARE_FAULT_SEED", "0"))
-    return Injector(FaultSpec(**kwargs))
+    kwargs.setdefault("seed", default_seed)
+    return FaultSpec(**kwargs)
+
+
+def from_env(environ=None) -> "Injector | CompositeInjector | None":
+    """Build an injector from ``KUBESHARE_FAULTS`` and
+    ``KUBESHARE_FAULT_SEED``. Returns None when unset.
+
+    ``;`` separates simultaneous spec groups (a composition); a group
+    without its own ``seed=`` derives ``KUBESHARE_FAULT_SEED + index``
+    so identical sibling specs never share a random stream. A single
+    group (no ``;``) builds the same plain :class:`Injector` as ever.
+    """
+    env = os.environ if environ is None else environ
+    raw = env.get("KUBESHARE_FAULTS", "").strip()
+    if not raw:
+        return None
+    base_seed = int(env.get("KUBESHARE_FAULT_SEED", "0"))
+    groups = [g for g in (part.strip() for part in raw.split(";")) if g]
+    specs = [parse_spec(g, default_seed=base_seed + i)
+             for i, g in enumerate(groups)]
+    return compose(*specs)

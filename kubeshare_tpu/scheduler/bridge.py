@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import ssl
 import threading
 import time
@@ -39,9 +40,15 @@ import urllib.parse
 import urllib.request
 
 from .. import constants as C
+from ..obs import metrics as obs_metrics
 from ..utils.logger import get_logger
 
 log = get_logger("bridge")
+
+_SVC_RETRIES = obs_metrics.default_registry().counter(
+    "kubeshare_service_client_retries_total",
+    "ServiceClient HTTP attempts retried after a transient failure.",
+    labels=("op",))
 
 SCHEDULER_NAME = "kubeshare-tpu-scheduler"
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -163,11 +170,23 @@ class KubeClient:
 
 
 class ServiceClient:
-    """HTTP client for :class:`.service.SchedulerService`."""
+    """HTTP client for :class:`.service.SchedulerService`.
+
+    Transient transport failures (connection refused while the service
+    restarts, socket timeouts) are retried with jittered backoff — the
+    same counted idiom as ``RegistryClient`` — so a scheduler bounce
+    mid-chaos does not fail watchers that could simply redial.  HTTP
+    error *responses* are never retried: the service answered, and the
+    schedule/resync bodies are idempotent only on the service side.
+    """
+
+    RETRY_ATTEMPTS = 3
+    RETRY_BACKOFF_S = 0.05
 
     def __init__(self, base_url: str, timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._open = urllib.request.urlopen   # injectable for tests
 
     def _call(self, method: str, path: str,
               body: dict | None = None) -> tuple[int, dict]:
@@ -176,15 +195,34 @@ class ServiceClient:
         if body is not None:
             data = json.dumps(body).encode()
             req.add_header("Content-Type", "application/json")
-        try:
-            with urllib.request.urlopen(req, data=data,
-                                        timeout=self.timeout) as r:
-                return r.status, json.load(r)
-        except urllib.error.HTTPError as e:
+        op = f"{method} /{path.strip('/').split('/')[0].split('?')[0]}"
+        last_exc: Exception = OSError("unreachable")
+        for attempt in range(self.RETRY_ATTEMPTS):
+            if attempt:
+                _SVC_RETRIES.inc(op)
+                time.sleep(self.RETRY_BACKOFF_S * (2 ** (attempt - 1))
+                           * (0.5 + random.random()))
             try:
-                return e.code, json.load(e)
-            except Exception:
-                return e.code, {"error": str(e)}
+                # chaos drill: a partitioned/bounced service looks like
+                # a transport failure (resilience/faults.py)
+                from ..resilience import faults as _faults
+                inj = _faults.active()
+                if inj is not None and inj.should_drop_service_call():
+                    raise OSError("injected service connection drop")
+                with self._open(req, data=data,
+                                timeout=self.timeout) as r:
+                    return r.status, json.load(r)
+            except urllib.error.HTTPError as e:
+                try:
+                    return e.code, json.load(e)
+                except Exception:
+                    return e.code, {"error": str(e)}
+            except (urllib.error.URLError, OSError) as exc:
+                last_exc = exc
+                log.warning("service %s %s attempt %d/%d failed: %s",
+                            method, path, attempt + 1,
+                            self.RETRY_ATTEMPTS, exc)
+        raise last_exc
 
     def schedule(self, namespace: str, name: str, labels: dict,
                  uid: str = "") -> tuple[int, dict]:
@@ -228,6 +266,15 @@ class ServiceClient:
         code, body = self._call("GET", "/serving")
         if code != 200:
             raise RuntimeError(f"/serving returned {code}")
+        return body
+
+    def invariants(self) -> dict:
+        """Cluster-invariant snapshot (``GET /invariants``,
+        doc/chaos.md): the chaos plane's catalog evaluated on the live
+        engine. RuntimeError when the scheduler predates it."""
+        code, body = self._call("GET", "/invariants")
+        if code != 200:
+            raise RuntimeError(f"/invariants returned {code}")
         return body
 
     def slo(self) -> dict:

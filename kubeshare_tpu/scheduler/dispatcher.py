@@ -919,6 +919,29 @@ class Dispatcher:
                      len(replayed))
         return replayed
 
+    # -- invariants --------------------------------------------------------
+
+    def invariant_snapshot(self) -> dict:
+        """One consistent pass of the chaos plane's engine invariants
+        (no-double-booking, booking-consistency, gang-atomicity) plus
+        queue counters, under the dispatcher lock — served on
+        ``GET /invariants`` and probed by ``doctor`` (doc/chaos.md)."""
+        from ..chaos import invariants as chaos_inv
+
+        with self._cond:
+            in_flight = set(self._pending) | set(self._parked)
+            violations = chaos_inv.check_engine(self.engine, in_flight)
+            return {
+                "ok": not violations,
+                "violations": violations,
+                "checked": ["no-double-booking", "booking-consistency",
+                            "gang-atomicity"],
+                "pending": len(self._pending),
+                "parked": len(self._parked),
+                "bound": sum(1 for p in self.engine.pod_status.values()
+                             if p.node_name),
+            }
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "Dispatcher":
@@ -942,8 +965,18 @@ class Dispatcher:
                 # when no notify arrives
                 self._cond.wait(min(delay, 0.2))
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = True) -> None:
+        """Stop the loop thread.  With ``drain`` (the default) one last
+        scheduling pass runs first, so work that can bind right now is
+        bound-and-resolved instead of abandoned in the queue — the
+        graceful half of a SIGTERM; parked gangs stay parked (their
+        reservations survive a restart via the registry replay)."""
         with self._cond:
+            if drain and not self._stop:
+                try:
+                    self._step_locked(self._clock())
+                except Exception:
+                    log.exception("drain step on stop failed")
             self._stop = True
             self._cond.notify_all()
         if self._thread is not None:

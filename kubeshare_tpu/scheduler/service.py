@@ -193,6 +193,20 @@ class SchedulerService:
         """``GET /slo`` body: objectives, burn rates, alert timeline."""
         return self.slo.state(now=self.dispatcher._clock())
 
+    def invariants_state(self) -> dict:
+        """``GET /invariants`` body: the chaos plane's cluster-invariant
+        catalog evaluated on the live engine (doc/chaos.md) plus, when a
+        front door is wired, the serving exactly-once ledger."""
+        snap = self.dispatcher.invariant_snapshot()
+        if self.serving is not None:
+            from ..chaos import invariants as chaos_inv
+
+            serving = chaos_inv.check_serving_exactly_once(self.serving)
+            snap["checked"].append("serving-exactly-once")
+            snap["violations"].extend(serving)
+            snap["ok"] = snap["ok"] and not serving
+        return snap
+
     def flightrecorder_state(self) -> dict:
         """``GET /flightrecorder`` body: ring summary + latest dump."""
         rec = obs_flight.default_recorder()
@@ -301,6 +315,8 @@ class SchedulerService:
                     return self._reply(200, svc.slo_state())
                 if self.path == "/flightrecorder":
                     return self._reply(200, svc.flightrecorder_state())
+                if self.path == "/invariants":
+                    return self._reply(200, svc.invariants_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -367,6 +383,13 @@ class SchedulerService:
         if self.remote_write is not None:
             self.remote_write.stop()
             self.remote_write = None
+        if self.serving is not None and self.serving.batcher is not None:
+            # graceful drain: ship every admitted serving request before
+            # the dispatcher goes away — SIGTERM must not strand riders
+            try:
+                self.serving.batcher.flush()
+            except Exception:
+                log.exception("serving drain on close failed")
         self.dispatcher.stop()
         if self._server is not None:
             self._server.shutdown()

@@ -193,8 +193,17 @@ class ContinuousBatcher:
     # --------------------------------------------------------- live pump
 
     def serve_loop(self, stop: threading.Event,
-                   idle_wait_s: float = 0.001) -> None:
-        """Wall-clock pump: run in a thread for live serving."""
+                   idle_wait_s: float = 0.001,
+                   drain_on_stop: bool = True) -> None:
+        """Wall-clock pump: run in a thread for live serving.
+
+        On ``stop`` the loop drains by default: every request already
+        admitted is shipped (ignoring max-wait) before the pump exits,
+        so a shutdown never strands riders whose futures would
+        otherwise hang — the serving half of graceful drain
+        (doc/serving.md; chaos scenarios that bounce the process
+        depend on it).
+        """
         fd = self.frontdoor
         while not stop.is_set():
             if self.step():
@@ -210,6 +219,8 @@ class ContinuousBatcher:
                 delay = min(max(deadline - time.monotonic(), 0.0),
                             0.05) or idle_wait_s
             stop.wait(delay)
+        if drain_on_stop:
+            self.flush()
 
     def describe(self) -> dict:
         return {

@@ -595,12 +595,29 @@ def main(argv=None) -> None:
                         help="with --critpath: also export each "
                              "synthetic process's spans to DIR/<source>"
                              ".jsonl for topcli --critpath --spans")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run the deterministic chaos-scenario "
+                             "suite (kubeshare_tpu/chaos, doc/chaos.md) "
+                             "in virtual time on --seed and print the "
+                             "machine-readable report: per-scenario "
+                             "MTTR, timeline, invariant violations")
+    parser.add_argument("--chaos-scenario", action="append", default=[],
+                        metavar="NAME",
+                        help="with --chaos: run only NAME (repeatable; "
+                             "default: every scenario)")
     args = parser.parse_args(argv)
 
     if sum(map(bool, (args.synthetic, args.trace, args.churn,
-                      args.serve, args.critpath))) != 1:
+                      args.serve, args.critpath, args.chaos))) != 1:
         parser.error("exactly one of --trace / --synthetic / --churn "
-                     "/ --serve / --critpath is required")
+                     "/ --serve / --critpath / --chaos is required")
+    if args.chaos:
+        from ..chaos import run_suite
+
+        out = run_suite(seed=args.seed,
+                        names=args.chaos_scenario or None)
+        print(json.dumps({"chaos": out}, sort_keys=True))
+        return
     if args.critpath:
         if args.spans_dir:
             import os

@@ -397,6 +397,38 @@ def _sparkline(values: list) -> str:
     return "".join(out)
 
 
+def invariants_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Cluster-invariant view (doc/chaos.md): the scheduler's
+    ``GET /invariants`` catalog — double-booking, booking consistency,
+    gang atomicity, serving exactly-once — evaluated on the live
+    engine under its own lock."""
+    snap: dict = {}
+    if scheduler is not None:
+        try:
+            snap = scheduler.invariants()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "invariants unavailable", file=sys.stderr)
+    return snap or {"ok": None, "violations": [], "checked": []}
+
+
+def render_invariants(snap: dict) -> str:
+    lines = ["INVARIANTS (chaos-plane catalog, doc/chaos.md)"]
+    if snap.get("ok") is None:
+        lines.append("  unavailable — name a scheduler with --scheduler")
+        return "\n".join(lines)
+    lines.append(
+        f"  {'OK' if snap['ok'] else 'VIOLATED'} — checked: "
+        f"{', '.join(snap.get('checked', []))}")
+    lines.append(
+        f"  pods: {snap.get('bound', 0)} bound / "
+        f"{snap.get('pending', 0)} pending / "
+        f"{snap.get('parked', 0)} parked")
+    for v in snap.get("violations", []):
+        lines.append(f"  ! {v.get('invariant')}: {v.get('detail')}")
+    return "\n".join(lines)
+
+
 def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
     """Telemetry-plane join: push freshness per instance (``/instances``)
     plus the FLEET_PANELS aggregations — each a single ``GET /query``
@@ -716,6 +748,12 @@ def main(argv=None) -> int:
                              "depth, admit/shed rates and p50/p99 (needs "
                              "--scheduler for /serving state) instead "
                              "of the fleet table")
+    parser.add_argument("--invariants", action="store_true",
+                        help="chaos-plane invariant catalog: "
+                             "double-booking, gang atomicity, serving "
+                             "exactly-once on the live engine (needs "
+                             "--scheduler for /invariants) instead of "
+                             "the fleet table")
     parser.add_argument("--fleet", action="store_true",
                         help="remote-write telemetry plane: per-instance "
                              "push freshness + fleet-wide windowed "
@@ -780,6 +818,10 @@ def main(argv=None) -> int:
                     svs = serving_snapshot(client, scheduler)
                     out = (json.dumps(svs) if args.json
                            else render_serving(svs))
+                elif args.invariants:
+                    ivs = invariants_snapshot(client, scheduler)
+                    out = (json.dumps(ivs) if args.json
+                           else render_invariants(ivs))
                 elif args.health:
                     hs = health_snapshot(client, scheduler)
                     out = json.dumps(hs) if args.json else render_health(hs)

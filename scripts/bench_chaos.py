@@ -1,0 +1,135 @@
+"""Chaos-plane benchmark: MTTR per fault class under composed faults
+(doc/chaos.md).
+
+Runs the full deterministic scenario suite (kubeshare_tpu/chaos) across
+several seeds and reports, per scenario, the mean-time-to-recovery from
+the end of the fault window to cluster reconvergence — in *virtual*
+seconds, so the numbers are properties of the control-plane logic
+(retry backoff, gang barriers, partition windows), not of the machine
+running the bench:
+
+- ``<scenario>.mttr_p50_s`` / ``.mttr_p99_s``: recovery time across
+  seeds (virtual seconds from last fault to converged-and-clean);
+- ``invariant_violations``: total invariant violations across every
+  scenario x seed — the headline correctness gate, must be 0;
+- ``converged``: every run reconverged inside its scenario bound.
+
+Run: ``python scripts/bench_chaos.py`` → one JSON object (committed as
+``bench_chaos.json``). ``--baseline FILE`` prints deltas; ``--write
+FILE`` saves fresh numbers (``make bench-chaos`` does both). ``--check``
+exits non-zero unless the zero-violation / convergence / MTTR bars
+hold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: the chaos-matrix seeds; >= 3 per the acceptance criteria
+SEEDS = (3, 11, 23)
+
+#: every scenario must reconverge within this many virtual seconds of
+#: its fault window across all seeds (a loose roof — the per-scenario
+#: bounds in scenarios.py are tighter and checked during the run)
+MTTR_ROOF_S = 30.0
+
+_HIGHER_IS_BETTER = ()
+
+
+def _metric_keys(out: dict) -> list:
+    keys = []
+    for name in sorted(out.get("scenarios", {})):
+        keys.append(f"{name}.mttr_p50_s")
+        keys.append(f"{name}.mttr_p99_s")
+    keys.append("invariant_violations")
+    return keys
+
+
+def _lookup(out: dict, key: str):
+    if "." in key:
+        name, metric = key.split(".", 1)
+        return out.get("scenarios", {}).get(name, {}).get(metric)
+    return out.get(key)
+
+
+def run_bench() -> dict:
+    from kubeshare_tpu.chaos import run_matrix
+
+    logging.disable(logging.CRITICAL)    # the runs are deliberately noisy
+    out = run_matrix(list(SEEDS))
+    logging.disable(logging.NOTSET)
+    return out
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (doc/chaos.md): zero invariant violations across
+    all seeds, every scenario reconverges, MTTR under the roof."""
+    bars = [
+        ("invariant_violations", out["invariant_violations"] == 0,
+         "no invariant may be violated under any scenario x seed"),
+        ("converged", out["converged"],
+         "every scenario must reconverge within its bound"),
+    ]
+    for name, scn in sorted(out.get("scenarios", {}).items()):
+        bars.append((f"{name}.mttr_p99_s",
+                     scn["mttr_p99_s"] <= MTTR_ROOF_S,
+                     f"recovery must land inside {MTTR_ROOF_S:g} virtual "
+                     f"seconds"))
+    failed = [f"{name}: {why} (got {_lookup(out, name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = _lookup(fresh, key), _lookup(base, key)
+        if new is None or old is None:
+            print(f"#   {key:40s} {old!s:>8} -> {new!s:>8}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:40s} {old!s:>8} -> {new!s:>8}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_chaos")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless zero violations, full "
+                             "convergence and the MTTR roof hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -335,6 +335,61 @@ def test_crash_mid_batch_recovers_from_journal(tmp_path):
     assert result["batch"] == "batch-2"
 
 
+def test_registry_restart_during_apply_never_double_moves(tmp_path):
+    """Double fault (doc/chaos.md): the telemetry registry restarts
+    mid-batch AND the process dies on the next move. The new
+    incarnation must fold the journal, not replay it — the completed
+    move stays where it landed, no (pod, from, to) is ever journaled
+    twice, and the engine + both journals come back invariant-clean."""
+    from kubeshare_tpu.chaos import invariants as chaos_inv
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+
+    reg_journal = str(tmp_path / "registry.jsonl")
+    ap_journal = str(tmp_path / "autopilot.jsonl")
+    eng = make_engine(hosts=2, mesh=(2, 2))
+    disp = Dispatcher(eng, TelemetryRegistry(journal=reg_journal))
+    a = [disp.submit("ns", f"a{i}", shared("0.6")) for i in range(8)]
+    disp.step()
+    b = [disp.submit("ns", f"b{i}", shared("0.4")) for i in range(8)]
+    disp.step()
+    assert all(disp.outcome(k).status == "bound" for k in a + b)
+    for k in a:
+        disp.delete(k)
+    plan = make_planner(disp).plan(now=0.0)
+    assert len(plan["moves"]) >= 2
+
+    class Crash(BaseException):          # process death, not a move error
+        pass
+
+    calls = []
+
+    def mover(mv, binding):
+        calls.append(mv["pod"])
+        if len(calls) == 1:
+            # fault 1: registry bounces mid-batch — the dispatcher's
+            # next publish goes to a fresh incarnation replaying the
+            # same journal
+            disp.registry._journal.close()
+            disp.registry = TelemetryRegistry(journal=reg_journal)
+        if len(calls) == 2:
+            raise Crash()                # fault 2: the process dies
+        return True
+
+    reb = Rebalancer(disp, journal_path=ap_journal, session_mover=mover)
+    with pytest.raises(Crash):
+        reb.apply(plan)
+
+    # new incarnation: the journaled move is durable, nothing replays
+    reb2 = Rebalancer(disp, journal_path=ap_journal)
+    assert reb2.recovered["completed"] == [plan["moves"][0]["pod"]]
+    result = reb2.apply(make_planner(disp).plan(now=0.0))
+    assert not result["rolled_back"]
+    assert chaos_inv.check_autopilot_journal_idempotent(ap_journal) == []
+    assert chaos_inv.check_engine(eng) == []
+    disp.registry._journal.close()
+    assert chaos_inv.check_registry_replay_idempotent(reg_journal) == []
+
+
 # --------------------------------------------------------------------------
 # elastic quota reclamation
 # --------------------------------------------------------------------------

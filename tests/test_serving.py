@@ -263,6 +263,75 @@ def test_park_resume_in_flight_tenant_session(clock):
         assert r.done                            # old results untouched
 
 
+def test_node_eviction_mid_park_manifest_stays_resumable(clock):
+    """Double fault (doc/chaos.md): the node backing a serving tenant
+    is health-evicted in the same virtual instant the tenant parks.
+    The manifest must stay a pure JSON value — resumable into a fresh
+    front door once the pod rebinds on a surviving node — and the
+    exactly-once ledger must balance with the manifest counted."""
+    import json
+
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.chaos import invariants as chaos_inv
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    eng = SchedulerEngine(clock=clock)
+    by_host = {}
+    for chip in FakeTopology(hosts=2, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in sorted(by_host.items()):
+        eng.add_node(host, chips)
+    disp = Dispatcher(eng, clock=clock)
+    key = disp.submit("serve", "tenant-s", {C.POD_TPU_REQUEST: "0.5",
+                                            C.POD_TPU_LIMIT: "1.0"})
+    disp.step(clock())
+    node = disp.outcome(key).binding.node
+
+    fd, batcher = make_stack(clock)
+    fd.register_tenant("s", tpu_class="latency")
+    for i in range(2):
+        fd.submit("s", row(i))
+    assert batcher.flush(clock.t) == 2
+    pending = [fd.submit("s", row(10 + i)) for i in range(3)]
+
+    # the double fault: node dies (veto + eviction requeues the pod)
+    # while the tenant is parked in the same instant
+    with disp.lock:
+        eng.veto_health(node, True)
+        eng.set_node_health(node, False)
+    disp.evict_node(node, clock())
+    manifest = fd.park("s")
+    for r in pending:
+        with pytest.raises(SessionParked):
+            r.result(0)
+
+    # mid-fault ledger: admitted == completed + parked, engine clean
+    # with the evicted pod counted as in-flight
+    assert chaos_inv.check_serving_exactly_once(
+        fd, parked_pending=len(manifest["pending"])) == []
+    assert chaos_inv.check_engine(eng, in_flight={key}) == []
+
+    # the pod rebinds away from the dead node...
+    clock.t += 1.0
+    disp.step(clock())
+    out = disp.outcome(key)
+    assert out.status == "bound" and out.binding.node != node
+
+    # ...and the manifest survives a process boundary verbatim
+    fd2, batcher2 = make_stack(clock)
+    restored = fd2.resume(json.loads(json.dumps(manifest)))
+    assert [r.rid for r in restored] == [2, 3, 4]
+    clock.t += 0.02
+    assert batcher2.step(clock.t) == 3
+    for i, r in enumerate(restored):
+        np.testing.assert_allclose(r.result(0), row(10 + i) * 2.0)
+    # exactly-once across both faults: 2 before + 3 after, no replays
+    assert fd.completed_total + fd2.completed_total == 5
+    assert fd.failed_total == 0 and fd2.failed_total == 0
+
+
 def test_resume_refuses_active_tenant_and_park_unknown(clock):
     fd, _ = make_stack(clock)
     fd.register_tenant("t")
