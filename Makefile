@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang image clean obs-check
 
 all: native
 
@@ -114,6 +114,16 @@ bench-fleet:
 bench-chaos:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_chaos.py --check \
 		--baseline bench_chaos.json --write bench_chaos.json
+
+# Gang-plane bench (doc/gang.md): coordinated vs uncoordinated grant
+# throughput for a 4-chip SPMD gang sharing its sub-mesh with a
+# best-effort co-tenant, a gang-atomic migration e2e with a
+# partial-grant-window sampler, and the gang chaos scenario across
+# >= 3 seeds; --check gates the >=1.5x speedup, zero-partial-window
+# and zero-violation bars, then refreshes bench_gang.json.
+bench-gang:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_gang.py --check \
+		--baseline bench_gang.json --write bench_gang.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -543,7 +543,11 @@ def _pin_visible_devices() -> bool:
     if not chips or os.environ.get("TPU_VISIBLE_DEVICES"):
         return False
     try:
-        indices = [str(int(c.rsplit("-", 1)[1]))
+        # a carved grant suffixes each chip with its mesh coord
+        # ("chip@x.y", gang/carve.py) — the local index lives on the
+        # chip id proper, so strip the suffix before parsing; seed-form
+        # grants pass through byte-identically
+        indices = [str(int(c.partition("@")[0].rsplit("-", 1)[1]))
                    for c in chips.split(",") if c]
     except (IndexError, ValueError):
         # Fail CLOSED (like _join_gang_or_die): the grant env is present

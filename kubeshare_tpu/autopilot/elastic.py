@@ -62,19 +62,36 @@ class _Credit:
     amount: float                       # window fraction lent
     lenders: set = field(default_factory=set)
     since_ms: float = 0.0
+    gang: str = ""                      # non-empty: gang-uniform credit
 
 
 class ElasticQuota:
-    """One policy instance over any number of per-chip TokenSchedulers."""
+    """One policy instance over any number of per-chip TokenSchedulers.
+
+    With a ``gang_coordinator`` wired (doc/gang.md), credit for a
+    borrower that is a gang member is applied *uniformly* across every
+    member chip via ``set_effective_gang`` instead of adjusting one
+    chip — a single-chip raise would be consumed by the gang-atomic
+    grant's slowest member and leave the mesh skewed. Gang broadcasts
+    never run under a chip's scheduler condition (the coordinator must
+    take OTHER chips' conditions): they are queued inside the locked
+    sections and flushed from :meth:`step` outside any chip lock. A
+    lender-demand revocation restores the lender's own chip
+    synchronously (that grant decision is already under base shares);
+    sibling chips are restored at the next flush."""
 
     def __init__(self, schedulers: dict | None = None,
                  idle_frac: float = 0.5, lend_frac: float = 0.75,
-                 hot_frac: float = 0.8):
+                 hot_frac: float = 0.8, gang_coordinator=None):
         self.idle_frac = idle_frac
         self.lend_frac = lend_frac
         self.hot_frac = hot_frac
+        self.gang_coordinator = gang_coordinator
         self._scheds: dict[str, object] = {}
         self._credits: dict[str, dict[str, _Credit]] = {}
+        #: deferred coordinator calls ("grant"/"restore", ...) queued
+        #: under chip conds, flushed lock-free by step()
+        self._gang_ops: list[tuple] = []
         self.reclaimed_ms = 0.0
         self.revocations = 0
         for chip, sched in (schedulers or {}).items():
@@ -107,7 +124,87 @@ class ElasticQuota:
         for chip, sched in self._scheds.items():
             with sched._cond:
                 out[chip] = self._step_chip_locked(chip, sched)
+        self._flush_gang_ops()
         return out
+
+    def _flush_gang_ops(self) -> None:
+        """Apply deferred gang-wide grants/restores. Runs with NO chip
+        condition held — the coordinator takes each member chip's
+        condition itself."""
+        ops, self._gang_ops = self._gang_ops, []
+        coord = self.gang_coordinator
+        if coord is None or not ops:
+            return
+        restored: set[str] = set()
+        for op in ops:
+            if op[0] == "restore":
+                gang = op[1]
+                if gang in restored:
+                    continue
+                restored.add(gang)
+                try:
+                    coord.restore_base(gang)
+                except Exception:
+                    log.exception("gang %s: restore_base failed", gang)
+                continue
+            _, gang, chip, name, eff_req, eff_limit = op
+            ok = False
+            if self._gang_has_slack(gang, name, eff_req):
+                try:
+                    ok = coord.set_effective_gang(gang, eff_req,
+                                                  eff_limit)
+                except Exception:
+                    log.exception("gang %s: set_effective_gang failed",
+                                  gang)
+            if not ok:
+                self._drop_credit(chip, name, reason="gang-refused")
+
+    def _gang_has_slack(self, gang: str, borrower: str,
+                        eff_req: float) -> bool:
+        """True when every member chip can absorb the raised request —
+        one chip's idle headroom must not oversubscribe a sibling whose
+        co-tenants the lender never saw. Measured against the siblings'
+        co-tenants' *observed* window usage, not their promised shares:
+        like the single-chip grant itself, an idle promise is exactly
+        the capacity being lent."""
+        members = self.gang_coordinator.gang_members(gang)
+        if not members:
+            return False
+        for mchip, mname in members:
+            sched = self._scheds.get(mchip)
+            if sched is None:
+                return False
+            base = sched.shares()
+            if mname not in base:
+                return False
+            total = eff_req
+            for cname in base:
+                if cname != mname:
+                    try:
+                        total += sched.window_usage(cname) / sched.window_ms
+                    except KeyError:
+                        pass       # removed between shares() and here
+            if total > 1.0 + 1e-9:
+                return False
+        return True
+
+    def _drop_credit(self, chip: str, name: str, reason: str) -> None:
+        """Forget a recorded credit whose gang broadcast was refused —
+        nothing was applied anywhere, so there is nothing to restore."""
+        sched = self._scheds.get(chip)
+        if sched is None:
+            return
+        with sched._cond:
+            credits = self._credits.get(chip) or {}
+            if credits.pop(name, None) is None:
+                return
+            if not credits:
+                self._credits.pop(chip, None)
+            _CREDIT.set(chip, name, value=0.0)
+        self.revocations += 1
+        _REVOKES.inc(reason)
+        log.info("chip %s: gang credit for %s dropped (%s)",
+                 chip, name, reason)
 
     def _step_chip_locked(self, chip: str, sched) -> dict:
         now = sched.now_ms()
@@ -161,11 +258,22 @@ class ElasticQuota:
             grant = new_limit - limit
             if grant <= 1e-9:
                 continue      # already at the whole window — nothing to lend
-            if not sched.set_effective(name, min(req + grant, new_limit),
-                                       new_limit):
+            gang = ""
+            if self.gang_coordinator is not None:
+                # chip-cond -> coordinator-lock nesting is the allowed
+                # direction (same order the demand hook uses)
+                gang = self.gang_coordinator.gang_for(chip, name) or ""
+            if gang:
+                # uniform raise across the gang — deferred, because the
+                # broadcast needs every member chip's condition
+                self._gang_ops.append(
+                    ("grant", gang, chip, name,
+                     min(req + grant, new_limit), new_limit))
+            elif not sched.set_effective(name, min(req + grant, new_limit),
+                                         new_limit):
                 return summary   # core predates set_effective: no credit
-            credits[name] = _Credit(amount=grant,
-                                    lenders=set(headroom), since_ms=now)
+            credits[name] = _Credit(amount=grant, lenders=set(headroom),
+                                    since_ms=now, gang=gang)
             _CREDIT.set(chip, name, value=grant)
             now_lent += grant
         if credits:
@@ -195,6 +303,11 @@ class ElasticQuota:
                 except Exception:
                     log.exception("revoking credit of %s on %s failed",
                                   name, chip)
+            if credit.gang:
+                # this chip is whole as of the line above; sibling
+                # chips are restored at the next step() flush (we
+                # cannot take their conditions from under this one)
+                self._gang_ops.append(("restore", credit.gang))
             lent_ms = credit.amount * max(0.0, now - credit.since_ms)
             self.reclaimed_ms += lent_ms
             _RECLAIMED.inc(amount=lent_ms)
@@ -215,7 +328,8 @@ class ElasticQuota:
                 chips[chip] = {
                     name: {"amount": round(cr.amount, 6),
                            "lenders": sorted(cr.lenders),
-                           "since_ms": cr.since_ms}
+                           "since_ms": cr.since_ms,
+                           "gang": cr.gang}
                     for name, cr in credits.items()}
         return {"chips": chips,
                 "reclaimed_ms": round(self.reclaimed_ms, 3),

@@ -47,17 +47,26 @@ class Rebalancer:
     """Executes accepted plans; owns the journal. One per dispatcher."""
 
     def __init__(self, dispatcher, journal_path: str | None = None,
-                 session_mover=None, planner=None, clock=time.time):
+                 session_mover=None, planner=None, clock=time.time,
+                 gang_coordinator=None,
+                 gang_pause_timeout_s: float = 5.0):
         """``session_mover(move, binding) -> bool`` streams the pod's
         proxy session to the new binding (resilience/migrate.py in a
         real deployment); False or an exception fails the move. None
         means engine-only moves (sim, tests, cold workloads).
         ``planner`` (optional) gets ``note_moved`` per applied move so
-        its cooldown rail sees what actually happened."""
+        its cooldown rail sees what actually happened.
+        ``gang_coordinator`` (optional, doc/gang.md) is paused around a
+        gang unit's moves: no gang-atomic token grant is in flight while
+        member bindings flip, so a mid-migration gang never runs an SPMD
+        step on a half-moved mesh — and never observes a partial-grant
+        window."""
         self.dispatcher = dispatcher
         self.journal_path = journal_path
         self.session_mover = session_mover
         self.planner = planner
+        self.gang_coordinator = gang_coordinator
+        self.gang_pause_timeout_s = gang_pause_timeout_s
         self._clock = clock
         self._batch_seq = 0
         self.applied_total = 0
@@ -172,60 +181,28 @@ class Rebalancer:
         self._journal({"event": "batch_begin", "batch": batch,
                        "moves": moves})
         for unit in self._units(moves):
-            flipped: list[dict] = []   # engine state moved to dest
-            failed = None
-            for mv in unit:
-                t0 = tracer.now_ms()
-                try:
-                    binding = self.dispatcher.apply_move(mv["pod"],
-                                                         mv["node"])
-                    flipped.append(mv)
-                    self._move_session(mv, binding)
-                except Exception as e:
-                    self._journal({"event": "move_failed", "batch": batch,
-                                   "pod": mv["pod"], "node": mv["node"],
-                                   "error": str(e)})
-                    log.warning("autopilot move %s -> %s failed: %s",
-                                mv["pod"], mv["node"], e)
-                    failed = mv
-                    break
-                self._journal({"event": "move_done", "batch": batch,
-                               "pod": mv["pod"], "from": mv.get("from", ""),
-                               "node": mv["node"]})
-                tracer.record("autopilot-move", "", t0, tracer.now_ms(),
-                              pod=mv["pod"], source=mv.get("from", ""),
-                              dest=mv["node"], batch=batch)
-            if failed is None:
-                for mv in unit:
-                    result["applied"].append(mv)
-                    self.applied_total += 1
-                    _MOVES.inc("applied")
-                    if self.planner is not None:
-                        self.planner.note_moved(
-                            mv["pod"], now=plan.get("generated_at"))
-                continue
-            # gang atomicity: undo the members (incl. the failed move's
-            # own flip when apply_move succeeded but the session didn't)
-            result["failed"].append(failed)
-            _MOVES.inc("failed")
-            for mv in reversed(flipped):
-                try:
-                    self.dispatcher.apply_move(mv["pod"],
-                                               mv.get("from", ""))
-                    self._journal({"event": "move_rolled_back",
-                                   "batch": batch, "pod": mv["pod"],
-                                   "node": mv.get("from", "")})
-                except Exception as e:
-                    # apply_move already requeued the pod — journal the
-                    # truth, the registry record stays consistent
-                    self._journal({"event": "rollback_failed",
-                                   "batch": batch, "pod": mv["pod"],
-                                   "error": str(e)})
-                    log.error("rollback of %s to %s failed: %s",
-                              mv["pod"], mv.get("from", ""), e)
-                result["rolled_back"].append(mv)
-                self.rolled_back_total += 1
-                _MOVES.inc("rolled_back")
+            gang = unit[0].get("group") or ""
+            paused = False
+            if gang and self.gang_coordinator is not None:
+                # grant freeze BEFORE the first member flips: pause
+                # returns only once any in-flight gang grant drained, so
+                # the flip happens inside a zero-partial-grant window
+                paused = self.gang_coordinator.pause(
+                    gang, timeout=self.gang_pause_timeout_s)
+                self._journal({"event": "gang_paused", "batch": batch,
+                               "gang": gang, "drained": paused})
+                if not paused:
+                    log.warning("gang %s: grant drain timed out before "
+                                "migration; moving anyway (coordinator "
+                                "stays paused for the flip)", gang)
+            try:
+                self._apply_unit(unit, batch, result, tracer,
+                                 generated_at=plan.get("generated_at"))
+            finally:
+                if gang and self.gang_coordinator is not None:
+                    self.gang_coordinator.resume(gang)
+                    self._journal({"event": "gang_resumed",
+                                   "batch": batch, "gang": gang})
         self._journal({"event": "batch_end", "batch": batch,
                        "applied": len(result["applied"]),
                        "rolled_back": len(result["rolled_back"])})
@@ -238,3 +215,61 @@ class Rebalancer:
                 failed=len(result["failed"]),
                 rolled_back=len(result["rolled_back"]))
         return result
+
+    def _apply_unit(self, unit, batch, result, tracer,
+                    generated_at=None) -> None:
+        """One atomic unit: apply every member move, roll the whole
+        unit back on any member's failure."""
+        flipped: list[dict] = []   # engine state moved to dest
+        failed = None
+        for mv in unit:
+            t0 = tracer.now_ms()
+            try:
+                binding = self.dispatcher.apply_move(mv["pod"],
+                                                     mv["node"])
+                flipped.append(mv)
+                self._move_session(mv, binding)
+            except Exception as e:
+                self._journal({"event": "move_failed", "batch": batch,
+                               "pod": mv["pod"], "node": mv["node"],
+                               "error": str(e)})
+                log.warning("autopilot move %s -> %s failed: %s",
+                            mv["pod"], mv["node"], e)
+                failed = mv
+                break
+            self._journal({"event": "move_done", "batch": batch,
+                           "pod": mv["pod"], "from": mv.get("from", ""),
+                           "node": mv["node"]})
+            tracer.record("autopilot-move", "", t0, tracer.now_ms(),
+                          pod=mv["pod"], source=mv.get("from", ""),
+                          dest=mv["node"], batch=batch)
+        if failed is None:
+            for mv in unit:
+                result["applied"].append(mv)
+                self.applied_total += 1
+                _MOVES.inc("applied")
+                if self.planner is not None:
+                    self.planner.note_moved(mv["pod"], now=generated_at)
+            return
+        # gang atomicity: undo the members (incl. the failed move's
+        # own flip when apply_move succeeded but the session didn't)
+        result["failed"].append(failed)
+        _MOVES.inc("failed")
+        for mv in reversed(flipped):
+            try:
+                self.dispatcher.apply_move(mv["pod"],
+                                           mv.get("from", ""))
+                self._journal({"event": "move_rolled_back",
+                               "batch": batch, "pod": mv["pod"],
+                               "node": mv.get("from", "")})
+            except Exception as e:
+                # apply_move already requeued the pod — journal the
+                # truth, the registry record stays consistent
+                self._journal({"event": "rollback_failed",
+                               "batch": batch, "pod": mv["pod"],
+                               "error": str(e)})
+                log.error("rollback of %s to %s failed: %s",
+                          mv["pod"], mv.get("from", ""), e)
+            result["rolled_back"].append(mv)
+            self.rolled_back_total += 1
+            _MOVES.inc("rolled_back")

@@ -17,6 +17,10 @@ The catalog (each maps to one ``check_*`` function below):
   the reservation double-entry);
 - **gang-atomicity** — a gang is bound all-or-nothing: the number of
   bound members of any group is 0 or the full headcount;
+- **gang-grant-atomicity** — no gang ever holds a strict subset of its
+  member chips' tokens past the coordinator's reserve window (the
+  two-phase gang grant either commits whole or releases whole,
+  doc/gang.md);
 - **token-shares** — per chip scheduler, effective fractional requests
   sum to <= 1.0 (Gemini's token contract survives elastic lending);
 - **hbm-conservation** — per proxy session, bytes charged equal live
@@ -107,6 +111,46 @@ def check_gang_atomicity(engine, in_flight=()) -> list[dict]:
                 "gang-atomicity",
                 f"gang {gkey}: {len(bound)}/{headcount} members bound "
                 f"(must be 0 or all)", gang=gkey))
+    return out
+
+
+# -- gang isolation: grant atomicity ------------------------------------
+
+
+def check_gang_grant_atomicity(coordinator, now=None,
+                               slack_s: float = 0.0) -> list[dict]:
+    """No partial gang ever holds a subset of member tokens past the
+    reserve window (doc/gang.md, two-phase reserve/commit contract).
+
+    A gang mid-reserve legitimately holds a partial set — but only for
+    up to ``reserve_window_s`` (+ ``slack_s`` for sampling jitter);
+    after that the coordinator must have released the partials. A gang
+    in ``held`` must hold EVERY member chip, and an ``idle`` gang must
+    hold none.
+    """
+    out: list[dict] = []
+    window = coordinator.reserve_window_s + slack_s
+    for st in coordinator.grant_states(now):
+        gang, held, members = st["gang"], set(st["held"]), set(st["members"])
+        if st["state"] == "held" and held != members:
+            out.append(violation(
+                "gang-grant-atomicity",
+                f"gang {gang}: marked held with {len(held)}/{len(members)} "
+                f"member tokens", gang=gang,
+                held=sorted(held), members=sorted(members)))
+        elif st["state"] == "idle" and held:
+            out.append(violation(
+                "gang-grant-atomicity",
+                f"gang {gang}: idle but still holds {sorted(held)}",
+                gang=gang, held=sorted(held)))
+        elif (st["state"] == "reserving" and held
+                and st["reserve_age_s"] > window):
+            out.append(violation(
+                "gang-grant-atomicity",
+                f"gang {gang}: partial reservation "
+                f"({len(held)}/{len(members)} tokens) outstanding "
+                f"{st['reserve_age_s']:.3f}s > reserve window {window:.3f}s",
+                gang=gang, held=sorted(held), members=sorted(members)))
     return out
 
 
@@ -282,13 +326,17 @@ def check_autopilot_journal_idempotent(path) -> list[dict]:
 def check_cluster(engine=None, token_scheds=None, proxy=None,
                   frontdoor=None, parked_pending: int = 0,
                   registry_journal=None, session_journal_dir=None,
-                  autopilot_journal=None) -> list[dict]:
+                  autopilot_journal=None, gang_coordinator=None,
+                  gang_slack_s: float = 0.0) -> list[dict]:
     """Run every applicable check; None components are skipped."""
     out: list[dict] = []
     if engine is not None:
         out.extend(check_engine(engine))
     if token_scheds:
         out.extend(check_token_shares(token_scheds))
+    if gang_coordinator is not None:
+        out.extend(check_gang_grant_atomicity(gang_coordinator,
+                                              slack_s=gang_slack_s))
     if proxy is not None:
         out.extend(check_hbm_conservation(proxy))
     if frontdoor is not None:

@@ -116,8 +116,19 @@ class ChaosRunner:
         self.batcher = ContinuousBatcher(self.fd, self.servable,
                                          max_wait_s=0.05,
                                          clock=self._clock)
+        from ..gang import GangTokenCoordinator
+
         self.autopilot = None
         self.token_scheds: dict = {}
+        # virtual-clock coordinator: auto_drive (non-blocking step per
+        # tick), used_scale 1.0 because the schedulers share the same
+        # virtual-seconds clock
+        self.gangcoord = GangTokenCoordinator(
+            reserve_window_s=4 * TICK_S, backoff_base_s=TICK_S,
+            backoff_max_s=4 * TICK_S, clock=self._clock, used_scale=1.0,
+            auto_hold_s=TICK_S)
+        self.gangcoord.auto_drive = True
+        self.disp.attach_gang_coordinator(self.gangcoord)
         self.parked: dict[str, dict] = {}        # tenant -> manifest
         self._serve_results: list = []
         self._lease_epoch = 0
@@ -267,6 +278,7 @@ class ChaosRunner:
                 sched = TokenScheduler(native=False, clock=self._clock,
                                        chip=chip_id)
                 self.token_scheds[chip_id] = sched
+                self.gangcoord.attach_chip(chip_id, sched)
             have = sched.shares()
             for name in list(have):
                 if name not in clients:
@@ -276,6 +288,7 @@ class ChaosRunner:
                     sched.add_client(name, min(req, 1.0), 1.0)
         for chip_id in list(self.token_scheds):
             if chip_id not in want:
+                self.gangcoord.detach_chip(chip_id)
                 del self.token_scheds[chip_id]
 
     # -- invariant sampling ---------------------------------------------
@@ -292,6 +305,8 @@ class ChaosRunner:
                          | set(self.disp._parked))
             found = invariants.check_engine(self.engine, in_flight)
         found.extend(invariants.check_token_shares(self.token_scheds))
+        found.extend(invariants.check_gang_grant_atomicity(
+            self.gangcoord, now=self.now, slack_s=2 * TICK_S))
         found.extend(invariants.check_serving_exactly_once(
             self.fd, self._parked_pending()))
         if journals:
@@ -318,6 +333,11 @@ class ChaosRunner:
                         pass            # partitioned — the point
             self._next_lease = self.now + LEASE_EVERY_S
         self.disp.step(self.now)
+        if self.gangcoord.gangs():
+            # keep the mirror fresh so gang grants see real schedulers,
+            # then advance every gang's grant cycle one notch
+            self._sync_token_scheds()
+            self.gangcoord.step(self.now)
         self.batcher.step(self.now)
 
     def _converged(self) -> bool:

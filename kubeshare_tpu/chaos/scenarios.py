@@ -198,6 +198,31 @@ def partition_during_gang_bind(seed: int) -> Scenario:
         ])
 
 
+def gang_grant_vs_eviction(seed: int) -> Scenario:
+    """A gang is actively taking coordinated token grants (its sub-mesh
+    shared with fractional singles) when one of its host nodes dies —
+    the gang-grant-atomicity invariant must hold through eviction,
+    rebind on the surviving capacity, and the node's return: no sample
+    may ever see the gang holding a strict subset of its member chips
+    outside a reserve window (doc/gang.md)."""
+    r = _rng("gang-grant-vs-eviction", seed)
+    down_at = _j(r, 1.0)
+    return Scenario(
+        "gang-grant-vs-eviction",
+        "node eviction racing live gang-atomic token grants",
+        [
+            # co-tenant singles share the gang's chips — the contention
+            # that makes uncoordinated per-chip grants skew
+            ChaosAction(0.0, "submit", params={"count": 2, "request": 0.3}),
+            ChaosAction(0.1, "submit_gang",
+                        params={"name": "ring", "headcount": 4,
+                                "request": 0.5}),
+            ChaosAction(down_at, "node_down", "host-1"),
+            ChaosAction(_j(r, down_at + 4.0), "node_up", "host-1"),
+            ChaosAction(_j(r, down_at + 5.0), "delete_prefix", "pod"),
+        ])
+
+
 BUILDERS = {
     "node-crash-flap": node_crash_flap,
     "registry-restart-mid-lease": registry_restart_mid_lease,
@@ -205,6 +230,7 @@ BUILDERS = {
     "autopilot-vs-eviction": autopilot_vs_eviction,
     "park-during-migration": park_during_migration,
     "partition-during-gang-bind": partition_during_gang_bind,
+    "gang-grant-vs-eviction": gang_grant_vs_eviction,
 }
 
 

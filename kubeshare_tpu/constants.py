@@ -56,6 +56,11 @@ POD_MANAGER_PORT = DOMAIN + "tpu_manager_port"
 # chip (single-tenant per process); it is pointed at its pod manager and the
 # chip stays owned by the proxy.
 ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+# Node mesh shape ("2x4") accompanying a carved TPU_VISIBLE_CHIPS value
+# (entries "chip@x.y", doc/gang.md) so the torus-aware block check in
+# gang/carve.py can validate wrap-around carves. Absent for seed-format
+# assignments; carve-unaware consumers ignore both.
+ENV_MESH_SHAPE = "KUBESHARE_TPU_MESH"
 ENV_POD_MANAGER_PORT = "KUBESHARE_TPU_POD_MANAGER_PORT"
 ENV_POD_NAME = "KUBESHARE_TPU_POD_NAME"
 ENV_SCHEDULER_IP = "KUBESHARE_TPU_SCHEDULER_IP"

@@ -275,6 +275,39 @@ def check_invariants(addr: str, timeout_s: float,
         f"pending)")
 
 
+def check_gangs(addr: str, timeout_s: float,
+                defaulted: bool = False) -> bool:
+    """Gang-plane probe (doc/gang.md): ``/gangs`` must answer — the
+    coordinator snapshot IS the liveness signal (it takes the same lock
+    every grant does) — and no gang may be stuck mid-reservation."""
+    if not addr or addr == "none":
+        return _result("gangs", "skip", "--scheduler none")
+    try:
+        snap = json.loads(_get(f"http://{addr}/gangs", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("gangs", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("gangs", "skip", "scheduler predates /gangs")
+        return _result("gangs", "fail", f"{addr}: {exc}")
+    gangs = snap.get("gangs", {}) if isinstance(snap, dict) else {}
+    reserving = [gid for gid, g in gangs.items()
+                 if g.get("state") == "reserving"]
+    if reserving:
+        return _result(
+            "gangs", "fail",
+            f"{len(reserving)} gang(s) stuck reserving "
+            f"({', '.join(sorted(reserving))}) — partial grants held past "
+            "the reserve window?")
+    held = sum(1 for g in gangs.values() if g.get("state") == "held")
+    return _result(
+        "gangs", "ok",
+        f"{addr}: coordinator live, {len(gangs)} gang(s) "
+        f"({held} held), {len(snap.get('chips', []))} chip(s) attached")
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -519,6 +552,7 @@ def main(argv=None) -> int:
     ok &= check_serving(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_slo(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_invariants(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_gangs(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

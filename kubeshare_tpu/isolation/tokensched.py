@@ -614,7 +614,8 @@ class TokenScheduler:
             self._cond.notify_all()
 
 
-def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
+def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0,
+          coordinator=None):
     """Expose a :class:`TokenScheduler` over framed-JSON TCP.
 
     Requests: ``{"op": "register", "name", "request", "limit"}`` (creates
@@ -630,9 +631,21 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
     Replies: ``{"ok": true, ...}`` or ``{"ok": false, "error": msg}``.
     The owning connection's disconnect removes the client (≙ gem-schd
     dropping a dead pod manager); attached connections' disconnects don't.
+
+    A server started with a :class:`~kubeshare_tpu.gang.coordinator.
+    GangTokenCoordinator` additionally speaks the gang-grant extension
+    (doc/isolation-wire.md, negotiated feature): ``gang_register`` /
+    ``gang_acquire`` / ``gang_release`` / ``gang_state``. Without a
+    coordinator those names answer the standard unknown-op error —
+    byte-for-byte the pre-extension wire — so an un-negotiated peer
+    observes no difference.
     """
     def handle(req: dict, state: dict) -> dict:
         op = req.get("op")
+        if coordinator is not None and op in (
+                "gang_register", "gang_acquire", "gang_release",
+                "gang_state"):
+            return _handle_gang(coordinator, op, req, state)
         if op not in ("register", "attach", "acquire", "renew", "release",
                       "usage", "unregister"):
             return {"ok": False, "error": f"unknown op {op!r}"}
@@ -687,5 +700,38 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0):
                 scheduler.remove_client(state["name"])
             except RuntimeError:
                 pass  # scheduler already closed — nothing left to free
+        if coordinator is not None:
+            for gang in state.get("gangs", ()):
+                try:
+                    coordinator.unregister_gang(gang)
+                except Exception:
+                    pass
 
     return protocol.serve_framed(host, port, handle, cleanup)
+
+
+def _handle_gang(coordinator, op: str, req: dict, state: dict) -> dict:
+    """Gang-grant wire extension (doc/gang.md). ``gang_register``
+    publishes membership and makes this connection the gang's owner
+    (disconnect withdraws it, mirroring client ownership);
+    ``gang_acquire``/``gang_release`` drive the two-phase gang-atomic
+    grant; ``gang_state`` returns the coordinator snapshot."""
+    if op == "gang_state":
+        return {"ok": True, "state": coordinator.snapshot()}
+    gang = req.get("gang")
+    if not gang:
+        raise ValueError("gang ops require a 'gang' id")
+    if op == "gang_register":
+        members = [(str(c), str(cl)) for c, cl in req["members"]]
+        coordinator.register_gang(
+            gang, members, namespace=req.get("namespace", ""),
+            tpu_class=req.get("class", "best-effort"))
+        state.setdefault("gangs", set()).add(gang)
+        return {"ok": True}
+    if op == "gang_acquire":
+        held = coordinator.acquire(gang, timeout=req.get("timeout"),
+                                   trace_id=req.get("trace_id", ""))
+        return {"ok": True, "held": dict(held)}
+    # gang_release
+    coordinator.release(gang, used_ms=req.get("used_ms"))
+    return {"ok": True}

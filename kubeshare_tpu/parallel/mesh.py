@@ -126,6 +126,62 @@ def shard_init(init_fn: Callable, key, mesh: Mesh):
     return jax.device_put(params, shardings)
 
 
+def make_carved_mesh(carve: str, devices=None,
+                     mesh_shape: str | tuple[int, ...] | None = None) -> Mesh:
+    """Build the gang's 2D ``(dp, tp)`` mesh from a carved
+    ``TPU_VISIBLE_CHIPS`` value (``"chip@x.y,..."``, doc/gang.md).
+
+    The carve is validated against the planned sub-mesh block first —
+    ``mesh_shape`` is the node mesh (``constants.ENV_MESH_SHAPE``, e.g.
+    ``"2x4"``) so wrap-around blocks validate; a non-contiguous carve
+    (the greedy-compact fallback's scatter picks, or a corrupted env)
+    raises :class:`~kubeshare_tpu.gang.carve.CarveError` rather than
+    silently building a mesh whose collectives hop off ICI.
+
+    ``devices`` defaults to ``jax.devices()`` and is laid onto the block
+    in row-major coordinate order, one device per carved chip, so
+    position in the mesh mirrors position on the torus. 1-D carves get
+    a ``(1, n)`` mesh; 2-D carves map block rows → dp, columns → tp.
+    The result feeds :class:`~jax.sharding.NamedSharding` exactly like
+    :func:`make_mesh` output.
+    """
+    from ..gang.carve import CarveError, carve_block, parse_mesh, parse_visible_chips
+
+    entries = parse_visible_chips(carve)
+    mesh = None
+    if mesh_shape:
+        mesh = parse_mesh(mesh_shape) if isinstance(mesh_shape, str) \
+            else tuple(mesh_shape)
+    origin, shape = carve_block(entries, mesh=mesh)
+    n = len(entries)
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n:
+        raise CarveError(
+            f"carve names {n} chips but only {len(devices)} devices "
+            f"are visible")
+    devices = devices[:n]
+    if len(shape) == 1:
+        dp, tp = 1, shape[0]
+    else:
+        dp = shape[0]
+        tp = n // shape[0]
+    # order devices by the carve's block position (devices[i] is the
+    # runtime device behind entries[i] — TPU_VISIBLE_DEVICES preserves
+    # the carve's entry order) so mesh neighbors are torus neighbors
+    def block_pos(c):
+        pos = []
+        for axis, (v, o) in enumerate(zip(c, origin)):
+            d = v - o
+            if mesh is not None:
+                d %= mesh[axis]
+            pos.append(d)
+        return tuple(pos)
+
+    order = sorted(range(n), key=lambda i: block_pos(entries[i][1]))
+    devices = [devices[i] for i in order]
+    return Mesh(np.array(devices).reshape(dp, tp), ("dp", "tp"))
+
+
 def make_hybrid_mesh(device_slices, tp: int | None = None) -> Mesh:
     """Mesh spanning MULTIPLE slices: axes ``(dcn, dp, tp)``.
 

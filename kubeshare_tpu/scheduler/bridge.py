@@ -294,6 +294,15 @@ class ServiceClient:
             raise RuntimeError(f"/flightrecorder returned {code}")
         return body
 
+    def gangs(self) -> dict:
+        """Gang isolation plane snapshot (``GET /gangs``, doc/gang.md):
+        membership, grant state, grant-wait percentiles per gang.
+        RuntimeError when the scheduler predates the plane."""
+        code, body = self._call("GET", "/gangs")
+        if code != 200:
+            raise RuntimeError(f"/gangs returned {code}")
+        return body
+
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
 

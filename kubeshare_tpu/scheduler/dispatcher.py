@@ -106,15 +106,21 @@ class _Parked:
     since: float = 0.0            # parked-at, for the gang-wait metric
 
 
-def _binding_of(pod: PodRequest) -> Binding:
+def _binding_of(pod: PodRequest, engine=None) -> Binding:
     """Reconstruct the Binding of an already-booked pod (resync/replay
-    paths) so status queries keep the full annotations + env contract."""
+    paths) so status queries keep the full annotations + env contract.
+    With *engine* given, gang/multi-chip pods regain their sub-mesh
+    carve (doc/gang.md) so a resynced member's env matches the original
+    bind."""
+    carve_kw = {}
+    if engine is not None and (pod.group_name or pod.multi_chip):
+        carve_kw = engine.carve_annotation(pod.node_name, pod.cells)
     return Binding(pod.key, pod.node_name, list(pod.chip_ids),
                    [c.id for c in pod.cells],
                    [c.cell_type for c in pod.cells], pod.memory, pod.port,
                    request=pod.request, limit=pod.limit,
                    group=pod.group_name, group_size=pod.headcount,
-                   group_rank=pod.group_rank)
+                   group_rank=pod.group_rank, **carve_kw)
 
 
 class Dispatcher:
@@ -157,6 +163,10 @@ class Dispatcher:
         #: on the dispatcher clock so alert timelines are deterministic
         #: under an injected clock
         self.slo = None
+        #: gang token coordinator (attach_gang_coordinator): receives
+        #: chip→member membership at bind/unbind so gang-atomic grants
+        #: span exactly the bound sub-mesh (doc/gang.md)
+        self.gangcoord = None
         self.shed_total = 0
         self._next_gc = 0.0
         self._stop = False
@@ -186,6 +196,42 @@ class Dispatcher:
 
         evaluator.add_listener(_on_alert)
         return self
+
+    def attach_gang_coordinator(self, coord) -> "Dispatcher":
+        """Wire a :class:`~..gang.coordinator.GangTokenCoordinator`:
+        every gang bind/resync/move publishes the gang's chip→member
+        map, every delete/eviction/rejection withdraws it — the
+        coordinator's registry always mirrors the bound sub-mesh."""
+        self.gangcoord = coord
+        return self
+
+    def _sync_gang(self, pod: PodRequest) -> None:
+        """Publish the CURRENT bound membership of *pod*'s gang to the
+        coordinator (caller holds the lock). Empty membership (last
+        member gone) withdraws the gang."""
+        if self.gangcoord is None or not pod.group_name:
+            return
+        # (chip, client) pairs — fractional members may co-locate on
+        # one chip, and each is its own token stream there
+        members: list[tuple[str, str]] = []
+        tpu_class = pod.tpu_class
+        for other in self.engine.pod_status.values():
+            if (other.group_name and other.group_key == pod.group_key
+                    and other.node_name and other.chip_ids):
+                for chip in other.chip_ids:
+                    members.append((chip, other.key))
+                tpu_class = other.tpu_class
+        try:
+            if members:
+                self.gangcoord.register_gang(pod.group_key, members,
+                                             namespace=pod.namespace,
+                                             tpu_class=tpu_class)
+            else:
+                self.gangcoord.unregister_gang(pod.group_key)
+        except Exception:
+            # membership publication must never take the loop with it
+            log.exception("gang coordinator publish failed for %s",
+                          pod.group_key)
 
     @property
     def lock(self) -> threading.Condition:
@@ -268,12 +314,15 @@ class Dispatcher:
         """Pod removal: reclaim + drop from every queue
         (deletePod, pod.go:91-136)."""
         with self._cond:
+            pod = self.engine.pod_status.get(key)
             self._pending.pop(key, None)
             self._retry_at.pop(key, None)
             self._parked.pop(key, None)
             self.engine.delete_pod(key)
             self._withdraw(key)
             self._resolve(key, Outcome("deleted"))  # evicts + drops reason
+            if pod is not None:
+                self._sync_gang(pod)
 
     def outcome(self, key: str) -> Outcome | None:
         with self._cond:
@@ -320,7 +369,9 @@ class Dispatcher:
             self._retry_at.pop(pod.key, None)
             self._parked.pop(pod.key, None)
             self._resolve(pod.key, Outcome("bound",
-                                           binding=_binding_of(pod)))
+                                           binding=_binding_of(pod,
+                                                               self.engine)))
+            self._sync_gang(pod)
 
     # -- the loop ----------------------------------------------------------
 
@@ -525,6 +576,7 @@ class Dispatcher:
                                if member.trace_span else ""),
                     pod=member.key)
                 self._resolve(key, Outcome("bound", binding=parked.binding))
+            self._sync_gang(pod)
 
     def _maybe_preempt(self, pod: PodRequest, now: float) -> bool:
         """A blocked guarantee pod may displace opportunistic pods
@@ -693,11 +745,14 @@ class Dispatcher:
             self.engine.unreserve(pod)    # also resets group_rank
             pod.group_rank = rank         # the member keeps its rank
             try:
-                return self._rebind_locked(pod, node)
+                binding = self._rebind_locked(pod, node)
+                self._sync_gang(pod)
+                return binding
             except Unschedulable as move_err:
                 pod.group_rank = rank
                 try:
                     self._rebind_locked(pod, source)
+                    self._sync_gang(pod)
                 except Unschedulable as back_err:
                     # catastrophic: neither side holds capacity anymore —
                     # fall back to the eviction path (cold requeue, no
@@ -712,6 +767,7 @@ class Dispatcher:
                                               f"({source} -> {node})")
                     self._results.pop(key, None)
                     self._withdraw(key)
+                    self._sync_gang(pod)
                     self._cond.notify_all()
                 raise Unschedulable(
                     f"{key}: move {source} -> {node} failed "
@@ -812,6 +868,14 @@ class Dispatcher:
                                      if pod.trace_span else ""),
                           pod=key, node=node, outcome=outcome)
             evicted.append(key)
+        if self.gangcoord is not None:
+            synced_groups: set[str] = set()
+            for key in evicted:
+                pod = eng.pod_status.get(key)
+                if (pod is not None and pod.group_name
+                        and pod.group_key not in synced_groups):
+                    synced_groups.add(pod.group_key)
+                    self._sync_gang(pod)
         log.warning("node %s lost: evicted %d pod(s): %s", node,
                     len(evicted), ", ".join(evicted))
         # a node loss is a black-box trigger: dump what the system was
@@ -844,6 +908,7 @@ class Dispatcher:
             self._parked.pop(key, None)
             self._withdraw(key)
             self._resolve(key, Outcome("rejected", reason))
+        self._sync_gang(pod)              # whole gang gone → withdraw
 
     def _withdraw(self, key: str) -> None:
         if self.registry is None:
@@ -909,8 +974,9 @@ class Dispatcher:
                     pod = self.engine.resync_bound(
                         namespace, name, labels, annotations,
                         rec.get("node", ""), uid=rec.get("uid", ""))
-                    self._results[key] = Outcome("bound",
-                                                 binding=_binding_of(pod))
+                    self._results[key] = Outcome(
+                        "bound", binding=_binding_of(pod, self.engine))
+                    self._sync_gang(pod)
                     replayed.append(key)
                 except Exception as e:
                     log.error("replay of %s failed: %s", key, e)
@@ -931,11 +997,16 @@ class Dispatcher:
         with self._cond:
             in_flight = set(self._pending) | set(self._parked)
             violations = chaos_inv.check_engine(self.engine, in_flight)
+            checked = ["no-double-booking", "booking-consistency",
+                       "gang-atomicity"]
+            if self.gangcoord is not None:
+                violations = violations + chaos_inv.\
+                    check_gang_grant_atomicity(self.gangcoord)
+                checked.append("gang-grant-atomicity")
             return {
                 "ok": not violations,
                 "violations": violations,
-                "checked": ["no-double-booking", "booking-consistency",
-                            "gang-atomicity"],
+                "checked": checked,
                 "pending": len(self._pending),
                 "parked": len(self._parked),
                 "bound": sum(1 for p in self.engine.pod_status.values()

@@ -44,6 +44,7 @@ from ..utils.bitmap import RRBitmap
 from ..utils.logger import get_logger
 from .filtering import filter_node
 from .labels import LabelError, PodRequest, parse_pod_labels
+from .meshselect import node_mesh_shape
 from .podgroup import PodGroup, PodGroupRegistry, queue_less
 from .scoring import (normalize_scores, score_guarantee_node,
                       score_opportunistic_node, score_regular_node,
@@ -100,6 +101,8 @@ class Binding:
     group: str = ""               # gang identity + this member's slot —
     group_size: int = 0           # the jax.distributed contract
     group_rank: int = -1          # (parallel.runner reads these)
+    chip_coords: list = field(default_factory=list)  # per-chip mesh coords
+    mesh_shape: str = ""          # node mesh ("2x4") the coords live on
 
     @property
     def annotations(self) -> dict[str, str]:
@@ -120,7 +123,17 @@ class Binding:
 
     @property
     def env(self) -> dict[str, str]:
-        env = {C.ENV_VISIBLE_CHIPS: ",".join(self.chip_ids)}
+        if self.chip_coords and len(self.chip_coords) == len(self.chip_ids):
+            # carved sub-mesh: "chip@x.y" entries (doc/gang.md). Seed
+            # consumers strip the suffix; parallel.mesh.make_carved_mesh
+            # rebuilds the planned block from it.
+            from ..gang.carve import carve_env
+            env = {C.ENV_VISIBLE_CHIPS: carve_env(self.chip_ids,
+                                                  self.chip_coords)}
+            if self.mesh_shape:
+                env[C.ENV_MESH_SHAPE] = self.mesh_shape
+        else:
+            env = {C.ENV_VISIBLE_CHIPS: ",".join(self.chip_ids)}
         if self.port:
             env[C.ENV_POD_MANAGER_PORT] = str(self.port)
             env[C.ENV_POD_NAME] = self.pod_key
@@ -590,6 +603,24 @@ class SchedulerEngine:
 
     normalize_scores = staticmethod(normalize_scores)
 
+    def carve_annotation(self, node_name: str, cells) -> dict:
+        """Sub-mesh carve fields for a Binding (doc/gang.md): the chosen
+        cells' mesh coords normalized to the node origin, plus the node
+        mesh shape — {} when the node's leaves carry no usable
+        coordinates, in which case the seed env format applies."""
+        if not cells or any(not getattr(c, "coords", None) for c in cells):
+            return {}
+        leaves = [leaf for leaf in self.leaf_cells.values()
+                  if leaf.node == node_name]
+        derived = node_mesh_shape(leaves)
+        if derived is None:
+            return {}
+        from ..gang.carve import format_mesh
+        origin, mesh = derived
+        coords = [tuple(x - o for x, o in zip(c.coords, origin))
+                  for c in cells]
+        return {"chip_coords": coords, "mesh_shape": format_mesh(mesh)}
+
     @_timed_phase("reserve")
     def reserve(self, pod: PodRequest, node_name: str) -> Binding:
         """Pick cells, book them, allocate the manager port, emit the
@@ -628,6 +659,11 @@ class SchedulerEngine:
         pod.node_name = node_name
         pod.cells = cells
         pod.chip_ids = [c.chip_id for c in cells]
+        if pod.group_name or pod.multi_chip:
+            # sub-mesh carve (doc/gang.md): annotate the binding with the
+            # selected cells' mesh coords so the env renders "chip@x.y"
+            # and the gang's runner can rebuild the planned block
+            group_kw.update(self.carve_annotation(node_name, cells))
         if pod.multi_chip:
             # whole leaves: book everything they have (pod.go:360-366),
             # recording the exact amounts — free memory at bind time, not

@@ -32,6 +32,8 @@ API (JSON):
   and the alert event timeline (doc/observability.md, SLO plane)
 - ``GET  /flightrecorder``  flight-recorder summary + the latest black-box
   dump (always-on bounded ring; dumped on alert/eviction/crash triggers)
+- ``GET  /gangs``     gang isolation plane: every bound gang's membership,
+  grant state, and grant-wait percentiles (doc/gang.md)
 - ``GET  /healthz``
 
 Overload shedding: with ``max_pending`` set, ``POST /schedule`` answers
@@ -85,6 +87,12 @@ class SchedulerService:
         # declared objectives evaluation is a no-op over an empty dict
         self.slo = obs_slo.default_evaluator()
         self.dispatcher.attach_slo(self.slo)
+        # gang isolation plane (doc/gang.md): the dispatcher publishes
+        # every bound gang's membership here; with no gangs the
+        # coordinator is an empty snapshot
+        from ..gang import GangTokenCoordinator
+        self.gangcoord = GangTokenCoordinator()
+        self.dispatcher.attach_gang_coordinator(self.gangcoord)
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
@@ -207,6 +215,14 @@ class SchedulerService:
             snap["ok"] = snap["ok"] and not serving
         return snap
 
+    def gangs_state(self) -> dict:
+        """``GET /gangs`` body: every registered gang's membership,
+        grant state, and grant-wait percentiles (doc/gang.md)."""
+        snap = self.gangcoord.snapshot()
+        snap["attached"] = True
+        snap["count"] = len(snap["gangs"])
+        return snap
+
     def flightrecorder_state(self) -> dict:
         """``GET /flightrecorder`` body: ring summary + latest dump."""
         rec = obs_flight.default_recorder()
@@ -317,6 +333,8 @@ class SchedulerService:
                     return self._reply(200, svc.flightrecorder_state())
                 if self.path == "/invariants":
                     return self._reply(200, svc.invariants_state())
+                if self.path == "/gangs":
+                    return self._reply(200, svc.gangs_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -471,7 +489,8 @@ def main(argv=None) -> None:
             svc.dispatcher, planner=planner,
             rebalancer=Rebalancer(svc.dispatcher, planner=planner,
                                   journal_path=(args.autopilot_journal
-                                                or None))))
+                                                or None),
+                                  gang_coordinator=svc.gangcoord)))
     svc.serve(args.host, args.port)
     if not args.no_remote_write:
         svc.start_remote_write(period_s=args.push_period)

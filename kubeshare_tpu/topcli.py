@@ -13,7 +13,8 @@ Usage::
                                    [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json] [--latency]
                                    [--health] [--autopilot] [--serving]
-                                   [--fleet] [--critpath --spans PATH ...]
+                                   [--gangs] [--fleet]
+                                   [--critpath --spans PATH ...]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
 ``--latency`` switches from the fleet table to the self-observability
@@ -46,6 +47,9 @@ joined with the registry's capacity and lease views.
 ``--serving`` renders the inference front door (``doc/serving.md``):
 per-tenant queue depth, admit/shed totals and request p50/p99 from the
 scheduler's ``/serving``, joined with the registry's capacity view.
+``--gangs`` renders the gang isolation plane (``doc/gang.md``): each
+co-scheduled gang's membership, grant state, and gang grant-wait
+p50/p99 from the scheduler's ``/gangs``.
 Exit 0 on a healthy read, 2 when the registry is unreachable.
 """
 
@@ -429,6 +433,48 @@ def render_invariants(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def gangs_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Gang isolation plane join view (doc/gang.md): the scheduler's
+    ``GET /gangs`` — membership, grant state, and grant-wait
+    percentiles per co-scheduled gang."""
+    snap: dict = {}
+    if scheduler is not None:
+        try:
+            snap = scheduler.gangs()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "gangs unavailable", file=sys.stderr)
+    return snap or {"attached": None, "gangs": {}, "chips": []}
+
+
+def render_gangs(snap: dict) -> str:
+    lines = ["GANGS (gang-atomic token grants, doc/gang.md)"]
+    if snap.get("attached") is None:
+        lines.append("  unavailable — name a scheduler with --scheduler")
+        return "\n".join(lines)
+    gangs = snap.get("gangs", {})
+    lines.append(f"  {len(gangs)} gang(s), "
+                 f"{len(snap.get('chips', []))} chip(s) attached, "
+                 f"reserve window {snap.get('reserve_window_s', 0):g}s")
+    if not gangs:
+        return "\n".join(lines)
+    lines.append(f"  {'GANG':<28} {'STATE':<10} {'MEMBERS':>7} "
+                 f"{'HELD':>5} {'GRANTS':>7} {'PARTIAL':>8} "
+                 f"{'WAIT p50':>9} {'p99':>8}")
+    for gid in sorted(gangs):
+        g = gangs[gid]
+        lines.append(
+            f"  {gid:<28} {g.get('state', '?'):<10} "
+            f"{len(g.get('members', [])):>7} "
+            f"{len(g.get('held', [])):>5} {g.get('grants', 0):>7} "
+            f"{g.get('partial_releases', 0):>8} "
+            f"{g.get('grant_wait_p50_ms', 0.0):>7.1f}ms "
+            f"{g.get('grant_wait_p99_ms', 0.0):>6.1f}ms")
+        for member in g.get("members", []):
+            lines.append(f"      {member}")
+    return "\n".join(lines)
+
+
 def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
     """Telemetry-plane join: push freshness per instance (``/instances``)
     plus the FLEET_PANELS aggregations — each a single ``GET /query``
@@ -754,6 +800,11 @@ def main(argv=None) -> int:
                              "exactly-once on the live engine (needs "
                              "--scheduler for /invariants) instead of "
                              "the fleet table")
+    parser.add_argument("--gangs", action="store_true",
+                        help="gang isolation plane: per-gang membership, "
+                             "grant state, and gang grant-wait p50/p99 "
+                             "(needs --scheduler for /gangs) instead of "
+                             "the fleet table")
     parser.add_argument("--fleet", action="store_true",
                         help="remote-write telemetry plane: per-instance "
                              "push freshness + fleet-wide windowed "
@@ -822,6 +873,10 @@ def main(argv=None) -> int:
                     ivs = invariants_snapshot(client, scheduler)
                     out = (json.dumps(ivs) if args.json
                            else render_invariants(ivs))
+                elif args.gangs:
+                    gs = gangs_snapshot(client, scheduler)
+                    out = (json.dumps(gs) if args.json
+                           else render_gangs(gs))
                 elif args.health:
                     hs = health_snapshot(client, scheduler)
                     out = json.dumps(hs) if args.json else render_health(hs)

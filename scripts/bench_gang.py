@@ -1,0 +1,418 @@
+"""Gang-plane benchmark: coordinated vs uncoordinated gang grants,
+gang-atomic migration, and the gang chaos gate (doc/gang.md).
+
+Three phases, one JSON object (committed as ``bench_gang.json``):
+
+- **gang** — a 4-chip SPMD gang (real jitted steps on its carved
+  virtual-CPU mesh, ``parallel.mesh.make_carved_mesh``) shares its
+  sub-mesh with one best-effort single-chip co-tenant on chip 0.
+  *Uncoordinated*: each member acquires its own chip token per step and
+  the gang barriers — members hold chips (and burn their window quota)
+  while waiting for the slowest grant, and the per-chip 50% windows
+  drift out of phase. *Coordinated*: one ``GangTokenCoordinator``
+  grant per step; waiting happens without holding and usage lands
+  aligned on every chip. Gate: coordinated aggregate step throughput
+  >= 1.5x uncoordinated.
+- **migration** — a runner loops gang-atomic grants while the autopilot
+  flip sequence runs (pause -> drain -> rebind to new chips -> resume);
+  a concurrent sampler polls ``grant_states`` throughout. Gate: zero
+  partial-grant windows (a gang observed ``held`` without every chip,
+  or holding chips while ``idle``).
+- **chaos** — ``run_matrix`` over the ``gang-grant-vs-eviction``
+  scenario across 3 seeds. Gate: zero invariant violations, full
+  reconvergence.
+
+Run: ``python scripts/bench_gang.py`` -> JSON on stdout. ``--baseline
+FILE`` prints deltas; ``--write FILE`` saves fresh numbers; ``--check``
+exits non-zero unless every bar holds (``make bench-gang`` does all
+three).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# 4 virtual CPU devices for the gang's carved mesh — must be set before
+# the first jax import anywhere in the process
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+GANG_CHIPS = 4
+WINDOW_MS = 400.0
+BASE_QUOTA_MS = 60.0
+MIN_QUOTA_MS = 5.0
+PHASE_S = 2.5            # wall seconds per throughput phase
+SOLO_HOLD_S = 0.008      # co-tenant hold per grant
+SPEEDUP_BAR = 1.5
+CHAOS_SEEDS = (3, 11, 23)
+
+_HIGHER_IS_BETTER = ("gang.coordinated_steps_per_s",
+                     "gang.uncoordinated_steps_per_s", "gang.speedup")
+
+
+# --------------------------------------------------------------------------
+# shared fixtures
+# --------------------------------------------------------------------------
+
+def make_step_fn():
+    """One real SPMD step jitted over the gang's carved (dp, tp) mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeshare_tpu.gang import carve_env
+    from kubeshare_tpu.parallel.mesh import make_carved_mesh
+
+    env = carve_env([f"chip-{i}" for i in range(GANG_CHIPS)],
+                    [(0, 0), (0, 1), (1, 0), (1, 1)])
+    mesh = make_carved_mesh(env, mesh_shape="2x2")
+    x = jax.device_put(jnp.ones((256, 256), jnp.float32) * 0.01,
+                       NamedSharding(mesh, P("dp", "tp")))
+
+    @jax.jit
+    def _step(a):
+        return jnp.tanh(a @ a.T) * 0.01 + a
+
+    _step(x).block_until_ready()        # compile outside the timed loop
+    state = {"x": x}
+
+    def step():
+        state["x"] = _step(state["x"])
+        state["x"].block_until_ready()
+
+    return step
+
+
+def make_chips(tag: str):
+    """Fresh per-chip TokenSchedulers with one gang member each and a
+    best-effort co-tenant single on chip 0."""
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    scheds, members = {}, []
+    for i in range(GANG_CHIPS):
+        chip = f"chip-{i}"
+        sched = TokenScheduler(WINDOW_MS, BASE_QUOTA_MS, MIN_QUOTA_MS,
+                               chip=f"{tag}-{chip}")
+        sched.add_client(f"g{i}", 0.5, 0.5)
+        members.append((chip, f"g{i}"))
+        scheds[chip] = sched
+    scheds["chip-0"].add_client("solo", 0.45, 0.5,
+                                tpu_class="best-effort")
+    return scheds, members
+
+
+def solo_loop(sched, stop):
+    """The co-tenant: grab chip 0, hold, release with honest usage."""
+    holds = 0
+    while not stop.is_set():
+        try:
+            sched.acquire("solo", timeout=0.5)
+        except TimeoutError:
+            continue
+        time.sleep(SOLO_HOLD_S)
+        sched.release("solo", SOLO_HOLD_S * 1000.0)
+        holds += 1
+    return holds
+
+
+# --------------------------------------------------------------------------
+# phase 1: coordinated vs uncoordinated gang step throughput
+# --------------------------------------------------------------------------
+
+def run_uncoordinated(step_fn) -> dict:
+    scheds, members = make_chips("unc")
+    stop = threading.Event()
+    solo_stop = threading.Event()
+    barrier = threading.Barrier(GANG_CHIPS)
+    counts = {"steps": 0, "solo": 0}
+    deadline = time.monotonic() + PHASE_S
+
+    def member(i, chip, name):
+        sched = scheds[chip]
+        try:
+            while not stop.is_set():
+                sched.acquire(name)
+                t0 = time.monotonic()
+                barrier.wait()          # hold the chip until all arrive
+                if i == 0:
+                    step_fn()
+                    counts["steps"] += 1
+                    if time.monotonic() >= deadline:
+                        stop.set()      # between barriers: seen by all
+                barrier.wait()
+                sched.release(name, (time.monotonic() - t0) * 1000.0)
+        except Exception:
+            stop.set()
+            barrier.abort()
+            raise
+
+    solo_t = threading.Thread(
+        target=lambda: counts.__setitem__(
+            "solo", solo_loop(scheds["chip-0"], solo_stop)))
+    solo_t.start()
+    threads = [threading.Thread(target=member, args=(i, c, n))
+               for i, (c, n) in enumerate(members)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=PHASE_S * 10)
+    solo_stop.set()
+    solo_t.join(timeout=5.0)
+    for sched in scheds.values():
+        sched.close()
+    return {"steps": counts["steps"], "solo_holds": counts["solo"]}
+
+
+def run_coordinated(step_fn) -> dict:
+    from kubeshare_tpu.gang import GangTokenCoordinator
+
+    scheds, members = make_chips("coord")
+    coord = GangTokenCoordinator(reserve_window_s=0.05,
+                                 backoff_base_s=0.002, backoff_max_s=0.02)
+    for chip, sched in scheds.items():
+        coord.attach_chip(chip, sched)
+    coord.register_gang("ring", members, namespace="bench",
+                        tpu_class="guarantee")
+    solo_stop = threading.Event()
+    counts = {"solo": 0}
+    solo_t = threading.Thread(
+        target=lambda: counts.__setitem__(
+            "solo", solo_loop(scheds["chip-0"], solo_stop)))
+    solo_t.start()
+    steps = 0
+    deadline = time.monotonic() + PHASE_S
+    while time.monotonic() < deadline:
+        coord.acquire("ring", timeout=5.0)
+        step_fn()
+        steps += 1
+        coord.release("ring")
+    solo_stop.set()
+    solo_t.join(timeout=5.0)
+    partials = coord.snapshot()["gangs"]["ring"]["partial_releases"]
+    for sched in scheds.values():
+        sched.close()
+    return {"steps": steps, "solo_holds": counts["solo"],
+            "partial_releases": partials}
+
+
+# --------------------------------------------------------------------------
+# phase 2: gang-atomic migration — zero partial-grant windows
+# --------------------------------------------------------------------------
+
+def run_migration() -> dict:
+    from kubeshare_tpu.gang import GangTokenCoordinator
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    coord = GangTokenCoordinator(reserve_window_s=0.05,
+                                 backoff_base_s=0.002, backoff_max_s=0.02)
+    placements = {}
+    for side in ("old", "new"):
+        for i in range(GANG_CHIPS):
+            chip = f"{side}-{i}"
+            sched = TokenScheduler(WINDOW_MS, BASE_QUOTA_MS, MIN_QUOTA_MS,
+                                   chip=chip)
+            sched.add_client(f"g{i}", 0.5, 0.5)
+            coord.attach_chip(chip, sched)
+            placements[chip] = sched
+    coord.register_gang(
+        "ring", [(f"old-{i}", f"g{i}") for i in range(GANG_CHIPS)])
+
+    stop = threading.Event()
+    violations = []
+
+    def runner():
+        while not stop.is_set():
+            try:
+                coord.acquire("ring", timeout=0.2)
+            except TimeoutError:
+                continue                # paused mid-migration
+            time.sleep(0.002)
+            coord.release("ring")
+
+    def sampler():
+        while not stop.is_set():
+            for st in coord.grant_states():
+                held = set(st["held"])
+                if st["state"] == "held" and held != set(st["members"]):
+                    violations.append(f"held with partial set {held}")
+                if st["state"] in ("idle", "paused") and held \
+                        and not st["paused"]:
+                    violations.append(f"idle holding {held}")
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=runner),
+               threading.Thread(target=sampler)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)                     # steady-state grants on old chips
+    grants_before = coord.snapshot()["gangs"]["ring"]["grants"]
+    t0 = time.monotonic()
+    paused = coord.pause("ring", timeout=5.0)   # autopilot flip sequence
+    drain_ms = (time.monotonic() - t0) * 1000.0
+    coord.register_gang(
+        "ring", [(f"new-{i}", f"g{i}") for i in range(GANG_CHIPS)])
+    coord.resume("ring")
+    time.sleep(0.4)                     # steady-state grants on new chips
+    grants_after = coord.snapshot()["gangs"]["ring"]["grants"]
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    for sched in placements.values():
+        sched.close()
+    return {
+        "paused_clean": bool(paused),
+        "pause_drain_ms": round(drain_ms, 3),
+        "grants_before_flip": grants_before,
+        "grants_after_flip": grants_after - grants_before,
+        "partial_grant_windows": len(violations),
+        "violations": violations[:5],
+    }
+
+
+# --------------------------------------------------------------------------
+# phase 3: chaos gate over the gang scenario
+# --------------------------------------------------------------------------
+
+def run_chaos() -> dict:
+    from kubeshare_tpu.chaos import run_matrix
+
+    logging.disable(logging.CRITICAL)
+    out = run_matrix(list(CHAOS_SEEDS), names=["gang-grant-vs-eviction"])
+    logging.disable(logging.NOTSET)
+    scn = out["scenarios"]["gang-grant-vs-eviction"]
+    return {
+        "seeds": list(CHAOS_SEEDS),
+        "invariant_violations": out["invariant_violations"],
+        "converged": out["converged"],
+        "mttr_p50_s": scn["mttr_p50_s"],
+        "mttr_p99_s": scn["mttr_p99_s"],
+    }
+
+
+# --------------------------------------------------------------------------
+# harness
+# --------------------------------------------------------------------------
+
+def run_bench() -> dict:
+    step_fn = make_step_fn()
+    unc = run_uncoordinated(step_fn)
+    coord = run_coordinated(step_fn)
+    unc_rate = unc["steps"] / PHASE_S
+    coord_rate = coord["steps"] / PHASE_S
+    return {
+        "gang": {
+            "chips": GANG_CHIPS,
+            "window_ms": WINDOW_MS,
+            "phase_s": PHASE_S,
+            "uncoordinated_steps_per_s": round(unc_rate, 2),
+            "coordinated_steps_per_s": round(coord_rate, 2),
+            "speedup": round(coord_rate / unc_rate, 3) if unc_rate
+            else float("inf"),
+            "uncoordinated_solo_holds": unc["solo_holds"],
+            "coordinated_solo_holds": coord["solo_holds"],
+            "coordinated_partial_releases": coord["partial_releases"],
+        },
+        "migration": run_migration(),
+        "chaos": run_chaos(),
+    }
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (doc/gang.md)."""
+    bars = [
+        ("gang.speedup", out["gang"]["speedup"] >= SPEEDUP_BAR,
+         f"coordinated grants must deliver >= {SPEEDUP_BAR}x the "
+         "uncoordinated aggregate step throughput"),
+        ("migration.partial_grant_windows",
+         out["migration"]["partial_grant_windows"] == 0,
+         "a gang-atomic migration must expose zero partial-grant "
+         "windows"),
+        ("migration.paused_clean", out["migration"]["paused_clean"],
+         "pause must drain the in-flight grant inside its timeout"),
+        ("migration.grants_after_flip",
+         out["migration"]["grants_after_flip"] > 0,
+         "grants must resume on the new placement"),
+        ("chaos.invariant_violations",
+         out["chaos"]["invariant_violations"] == 0,
+         "the gang chaos scenario must report zero invariant "
+         "violations across all seeds"),
+        ("chaos.converged", out["chaos"]["converged"],
+         "the gang chaos scenario must reconverge on every seed"),
+    ]
+    failed = [f"{name}: {why} (got {_lookup(out, name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _metric_keys(out: dict) -> list:
+    return ["gang.uncoordinated_steps_per_s",
+            "gang.coordinated_steps_per_s", "gang.speedup",
+            "migration.partial_grant_windows", "migration.pause_drain_ms",
+            "chaos.invariant_violations", "chaos.mttr_p99_s"]
+
+
+def _lookup(out: dict, key: str):
+    node = out
+    for part in key.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = _lookup(fresh, key), _lookup(base, key)
+        if new is None or old is None:
+            print(f"#   {key:40s} {old!s:>8} -> {new!s:>8}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:40s} {old!s:>8} -> {new!s:>8}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_gang")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the speedup, zero-partial-"
+                             "window and zero-violation bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -268,6 +268,35 @@ def test_gang_locality_prefers_same_host():
     assert len(hosts) == 1  # locality keeps the gang on one host
 
 
+def test_gang_binding_env_round_trips_to_planned_block():
+    """The carved TPU_VISIBLE_CHIPS env (doc/gang.md) must parse back to
+    exactly the contiguous sub-mesh block the scheduler planned, and the
+    seed-format chip list must survive a strip."""
+    from kubeshare_tpu.gang import (carve_block, parse_mesh,
+                                    parse_visible_chips, strip_carve)
+
+    eng = engine_with(hosts=1, mesh=(2, 2))
+    labels = shared_labels("1", "1", **{
+        C.POD_GROUP_NAME: "ring", C.POD_GROUP_HEADCOUNT: "4",
+        C.POD_GROUP_THRESHOLD: "1.0"})
+    pods = [eng.submit("ns", f"w-{i}", dict(labels)) for i in range(4)]
+    bindings = [eng.schedule(p) for p in pods]
+    coords, mesh_shapes = [], set()
+    for b in bindings:
+        env = b.env
+        assert C.ENV_MESH_SHAPE in env, "carve annotation missing"
+        mesh_shapes.add(env[C.ENV_MESH_SHAPE])
+        entries = parse_visible_chips(env[C.ENV_VISIBLE_CHIPS])
+        assert all(c is not None for _chip, c in entries)
+        assert strip_carve(env[C.ENV_VISIBLE_CHIPS]) == ",".join(b.chip_ids)
+        coords.extend(entries)
+    assert len(mesh_shapes) == 1
+    mesh = parse_mesh(mesh_shapes.pop())
+    origin, shape = carve_block(coords, mesh=mesh)
+    # the union of the members' carves IS the planned 2x2 block
+    assert shape == (2, 2) and mesh == (2, 2) and origin == (0, 0)
+
+
 # --------------------------------------------------------------------------
 # BASELINE config 5: heterogeneous topology-aware placement
 # --------------------------------------------------------------------------
