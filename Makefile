@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention image clean obs-check
 
 all: native
 
@@ -39,7 +39,8 @@ test-slow:
 # processes (doc/observability.md).
 obs-check:
 	$(PY) -m pytest tests/test_obs.py tests/test_trace_propagation.py \
-		tests/test_slo.py tests/test_tsdb.py tests/test_critpath.py -x -q
+		tests/test_slo.py tests/test_tsdb.py tests/test_critpath.py \
+		tests/test_ledger.py -x -q
 	$(PY) scripts/trace_demo.py
 	JAX_PLATFORMS=cpu $(PY) -m kubeshare_tpu.sim.simulator --synthetic 300 \
 		--slo 'queue-wait-p99<=500ms,availability>=99' \
@@ -124,6 +125,17 @@ bench-chaos:
 bench-gang:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_gang.py --check \
 		--baseline bench_gang.json --write bench_gang.json
+
+# Contention-attribution bench (doc/observability.md): a latency-class
+# tenant against a work-conserving best-effort flooder on one shared
+# chip through the full token-scheduler façade with the chip-time
+# ledger + blame graph attached, plus the deterministic sim
+# --contention replay; --check gates the flooder-top-blamed,
+# ledger-conservation (<=1%) and blame-vs-histogram (<=5%) bars, then
+# refreshes bench_contention.json.
+bench-contention:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_contention.py --check \
+		--baseline bench_contention.json --write bench_contention.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

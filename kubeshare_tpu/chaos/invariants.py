@@ -28,6 +28,10 @@ The catalog (each maps to one ``check_*`` function below):
   refunded implies the residual equals what is actually resident);
 - **serving-exactly-once** — every admitted request is accounted as
   completed, failed, still queued, or parked — never silently dropped;
+- **ledger-conservation** — per chip, the chip-time ledger's interval
+  states partition the timeline: no gaps, no overlaps, and the
+  per-state sums equal elapsed time within 1%
+  (``obs/ledger.py``, doc/observability.md);
 - **journal-idempotency** — replaying a registry / session / autopilot
   journal twice yields exactly the state one replay yields.
 """
@@ -214,6 +218,19 @@ def check_serving_exactly_once(frontdoor,
     return []
 
 
+# -- chip-time ledger: timeline conservation ----------------------------
+
+
+def check_ledger_conservation(ledger, now=None,
+                              tolerance: float = 0.01) -> list[dict]:
+    """The chip-time ledger's interval states partition every chip's
+    timeline: gapless, non-overlapping, and summing to elapsed time
+    within *tolerance* (obs/ledger.py — the contention-attribution
+    substrate's accounting must itself conserve)."""
+    return [violation("ledger-conservation", detail)
+            for detail in ledger.check(now=now, tolerance=tolerance)]
+
+
 # -- journals: replay idempotency ---------------------------------------
 
 
@@ -327,11 +344,13 @@ def check_cluster(engine=None, token_scheds=None, proxy=None,
                   frontdoor=None, parked_pending: int = 0,
                   registry_journal=None, session_journal_dir=None,
                   autopilot_journal=None, gang_coordinator=None,
-                  gang_slack_s: float = 0.0) -> list[dict]:
+                  gang_slack_s: float = 0.0, ledger=None) -> list[dict]:
     """Run every applicable check; None components are skipped."""
     out: list[dict] = []
     if engine is not None:
         out.extend(check_engine(engine))
+    if ledger is not None:
+        out.extend(check_ledger_conservation(ledger))
     if token_scheds:
         out.extend(check_token_shares(token_scheds))
     if gang_coordinator is not None:

@@ -118,15 +118,21 @@ class ChaosRunner:
                                          clock=self._clock)
         from ..gang import GangTokenCoordinator
 
+        from ..obs.ledger import ChipTimeLedger
+
         self.autopilot = None
         self.token_scheds: dict = {}
+        # per-run chip-time ledger on the virtual clock: every mirrored
+        # TokenScheduler and the coordinator feed it, and _sample checks
+        # its conservation property (doc/observability.md)
+        self.ledger = ChipTimeLedger(clock=self._clock)
         # virtual-clock coordinator: auto_drive (non-blocking step per
         # tick), used_scale 1.0 because the schedulers share the same
         # virtual-seconds clock
         self.gangcoord = GangTokenCoordinator(
             reserve_window_s=4 * TICK_S, backoff_base_s=TICK_S,
             backoff_max_s=4 * TICK_S, clock=self._clock, used_scale=1.0,
-            auto_hold_s=TICK_S)
+            auto_hold_s=TICK_S, ledger=self.ledger)
         self.gangcoord.auto_drive = True
         self.disp.attach_gang_coordinator(self.gangcoord)
         self.parked: dict[str, dict] = {}        # tenant -> manifest
@@ -276,7 +282,8 @@ class ChaosRunner:
             sched = self.token_scheds.get(chip_id)
             if sched is None:
                 sched = TokenScheduler(native=False, clock=self._clock,
-                                       chip=chip_id)
+                                       chip=chip_id, ledger=self.ledger,
+                                       ledger_clock=self._clock)
                 self.token_scheds[chip_id] = sched
                 self.gangcoord.attach_chip(chip_id, sched)
             have = sched.shares()
@@ -307,6 +314,8 @@ class ChaosRunner:
         found.extend(invariants.check_token_shares(self.token_scheds))
         found.extend(invariants.check_gang_grant_atomicity(
             self.gangcoord, now=self.now, slack_s=2 * TICK_S))
+        found.extend(invariants.check_ledger_conservation(
+            self.ledger, now=self.now))
         found.extend(invariants.check_serving_exactly_once(
             self.fd, self._parked_pending()))
         if journals:

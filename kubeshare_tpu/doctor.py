@@ -308,6 +308,43 @@ def check_gangs(addr: str, timeout_s: float,
         f"({held} held), {len(snap.get('chips', []))} chip(s) attached")
 
 
+def check_ledger(addr: str, timeout_s: float,
+                 defaulted: bool = False) -> bool:
+    """Contention-plane probe (doc/observability.md): ``/ledger`` must
+    answer, and the chip-time ledger's own conservation property —
+    per-state seconds summing to elapsed time within 1% on every chip —
+    must hold (the accounting that blames tenants must itself add up)."""
+    if not addr or addr == "none":
+        return _result("ledger", "skip", "--scheduler none")
+    try:
+        snap = json.loads(_get(f"http://{addr}/ledger", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("ledger", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("ledger", "skip", "scheduler predates /ledger")
+        return _result("ledger", "fail", f"{addr}: {exc}")
+    chips = snap.get("chips", {}) if isinstance(snap, dict) else {}
+    broken = []
+    for cid, c in chips.items():
+        elapsed = float(c.get("elapsed_s", 0.0))
+        accounted = sum(float(v) for v in c.get("by_state", {}).values())
+        if abs(accounted - elapsed) > max(0.01 * max(elapsed, 1e-9), 1e-6):
+            broken.append(cid)
+    if broken:
+        return _result(
+            "ledger", "fail",
+            f"conservation violated on {len(broken)} chip(s) "
+            f"({', '.join(sorted(broken))}) — per-state sums != elapsed")
+    edges = len((snap.get("blame") or {}).get("edges", []))
+    return _result(
+        "ledger", "ok",
+        f"{addr}: {len(chips)} chip timeline(s) conserve, "
+        f"{edges} blame edge(s)")
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -553,6 +590,7 @@ def main(argv=None) -> int:
     ok &= check_slo(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_invariants(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_gangs(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_ledger(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

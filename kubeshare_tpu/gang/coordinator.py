@@ -109,7 +109,7 @@ class GangTokenCoordinator:
     def __init__(self, reserve_window_s: float = 0.25,
                  backoff_base_s: float = 0.01, backoff_max_s: float = 0.2,
                  clock=None, used_scale: float = 1000.0, rng=None,
-                 auto_hold_s: float = 0.05):
+                 auto_hold_s: float = 0.05, ledger=None):
         self.reserve_window_s = reserve_window_s
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
@@ -120,6 +120,12 @@ class GangTokenCoordinator:
         #: :meth:`acquire` is the live-runner mode — don't mix per gang.
         self.auto_drive = False
         self._clock = clock or time.monotonic
+        #: chip-time ledger (obs/ledger.py). Member acquires/releases
+        #: already land in the ledger through each chip's TokenScheduler;
+        #: the coordinator overlays the gang-specific states — the
+        #: two-phase ``reserving`` window, the commit, and migration
+        #: pause windows — on this clock (seconds, same as ``clock``).
+        self._ledger = ledger
         self._rng = rng or random.Random(0xD1CE)
         self._lock = threading.Condition()
         self._scheds: dict[str, object] = {}
@@ -271,6 +277,7 @@ class GangTokenCoordinator:
                             for chip, (_cl, quota) in g.held.items()}
                     ns, cls = g.namespace, g.tpu_class
             if committed:
+                self._mark_committed(held)
                 self._note_grant(gang_id, ns, cls, wait_s, held, trace_id)
                 return held
             # migration flip raced the commit: give the tokens back and
@@ -301,7 +308,22 @@ class GangTokenCoordinator:
                 return f"chip {chip}: {exc}"
             with self._lock:
                 g.held[chip] = (client, quota)
+            self._mark_reserving(g, chip)
         return None
+
+    def _mark_reserving(self, g: _Gang, chip: str) -> None:
+        # overlay the gang two-phase window on the member acquire the
+        # chip's TokenScheduler just recorded as a plain grant
+        if self._ledger is not None:
+            self._ledger.mark_reserving(
+                chip, g.namespace or "default", g.tpu_class,
+                gang=g.gang_id, now=self._clock())
+
+    def _mark_committed(self, held) -> None:
+        if self._ledger is not None:
+            now = self._clock()
+            for chip in held:
+                self._ledger.commit(chip, now=now)
 
     def _backoff_sleep(self, attempt: int, deadline: float | None) -> None:
         delay = min(self.backoff_max_s,
@@ -385,16 +407,27 @@ class GangTokenCoordinator:
                 if not self._lock.wait(self._remaining(deadline)):
                     _GANG_PAUSED.set(gang_id, value=1.0)
                     return False
+            chips = [c for c, _cl in self._reserve_plan(g.members)]
         _GANG_PAUSED.set(gang_id, value=1.0)
+        if self._ledger is not None:
+            now = self._clock()
+            for chip in chips:
+                self._ledger.pause(chip, now=now)
         return True
 
     def resume(self, gang_id: str) -> None:
         with self._lock:
             g = self._gangs.get(gang_id)
+            chips = ([c for c, _cl in self._reserve_plan(g.members)]
+                     if g is not None else [])
             if g is not None:
                 g.paused = False
                 self._lock.notify_all()
         _GANG_PAUSED.set(gang_id, value=0.0)
+        if self._ledger is not None:
+            now = self._clock()
+            for chip in chips:
+                self._ledger.unpause(chip, now=now)
 
     # -- uniform effective shares (elastic plane) ---------------------
 
@@ -500,6 +533,7 @@ class GangTokenCoordinator:
             with self._lock:
                 g.held[chip] = (client, quota)
                 held[chip] = (client, quota)
+            self._mark_reserving(g, chip)
         if complete and len(held) == len(plan):
             with self._lock:
                 raced_pause = g.paused
@@ -511,6 +545,8 @@ class GangTokenCoordinator:
                     g.waits.append(max(0.0, now - g.reserve_started))
             if raced_pause:
                 self._release_held(g, used=0.0)
+            else:
+                self._mark_committed(held)
             return
         if now - g.reserve_started > self.reserve_window_s:
             with self._lock:

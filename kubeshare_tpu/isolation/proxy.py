@@ -287,8 +287,16 @@ class ChipProxy:
         self._jax = jax
         self.device = device if device is not None else jax.devices()[0]
         self.platform = self.device.platform
+        # default scheduler feeds the process-global chip-time ledger +
+        # blame graph (obs/ledger.py): grant/release/execute intervals
+        # and wait attribution with zero extra wiring. An injected
+        # scheduler keeps whatever ledger its builder chose.
+        from ..obs.blame import default_blame
+        from ..obs.ledger import default_ledger
         self.scheduler = (scheduler if scheduler is not None
-                          else TokenScheduler(chip=str(self.device)))
+                          else TokenScheduler(chip=str(self.device),
+                                              ledger=default_ledger(),
+                                              blame=default_blame()))
         self.idle_release_ms = idle_release_ms
         self.detach_grace_ms = detach_grace_ms
         self.journal = SessionJournal(journal_dir)
@@ -652,10 +660,19 @@ class ChipProxy:
                     sess.quota_ms = quota
                     sess.used_ms = 0.0
             start = _now_ms()
+            # bracket the execute for the chip-time ledger: the hold is
+            # granted-active only while fn() runs (getattr: injected
+            # schedulers in tests may predate the ledger hooks)
+            exec_begin = getattr(self.scheduler, "execute_begin", None)
+            if exec_begin is not None:
+                exec_begin()
             try:
                 result = fn()
             finally:
                 end = _now_ms()
+                exec_end = getattr(self.scheduler, "execute_end", None)
+                if exec_end is not None:
+                    exec_end()
                 wall = end - start
                 elapsed = (timing.get("exec_ms", wall)
                            if timing is not None else wall)
@@ -1755,8 +1772,11 @@ def main(argv=None) -> None:
     if inj is not None:
         _faults.install(inj)
 
+    from ..obs.blame import default_blame
+    from ..obs.ledger import default_ledger
     sched = TokenScheduler(window_ms=args.window, base_quota_ms=args.base_quota,
-                           min_quota_ms=args.min_quota)
+                           min_quota_ms=args.min_quota,
+                           ledger=default_ledger(), blame=default_blame())
     proxy = ChipProxy(scheduler=sched,
                       journal_dir=args.journal_dir or None)
     server = proxy.serve(args.host, args.port)

@@ -338,7 +338,8 @@ class TokenScheduler:
     def __init__(self, window_ms: float = WINDOW_MS,
                  base_quota_ms: float = BASE_QUOTA_MS,
                  min_quota_ms: float = MIN_QUOTA_MS, native: bool | None = None,
-                 clock=None, chip: str = ""):
+                 clock=None, chip: str = "", ledger=None, blame=None,
+                 ledger_clock=None):
         self._core = make_core(window_ms, base_quota_ms, min_quota_ms, native)
         self._cond = threading.Condition()
         self._grants: dict[str, float] = {}  # name -> granted quota_ms
@@ -357,6 +358,15 @@ class TokenScheduler:
         #: workload class per client (sharedtpu/class) — the grant-wait
         #: histogram's per-tenant attribution (ROADMAP item 1 surface)
         self._classes: dict[str, str] = {}
+        #: chip-time ledger + blame graph (doc/observability.md,
+        #: contention attribution). ``ledger_clock`` returns SECONDS and
+        #: is deliberately separate from ``clock``: the core clock is
+        #: milliseconds live but the chaos plane injects its
+        #: virtual-seconds clock there — the ledger timebase must not
+        #: inherit that ambiguity.
+        self._ledger = ledger
+        self._blame = blame
+        self._ledger_clock = ledger_clock or time.monotonic
         #: demand hook (elastic quota, doc/autopilot.md): called as
         #: ``on_demand(name)`` under the lock the moment a client asks
         #: for the token, BEFORE the grant decision — a lender whose
@@ -381,7 +391,11 @@ class TokenScheduler:
         with self._cond:
             self._core.remove_client(name)
             self._grants.pop(name, None)
-            self._held_since.pop(name, None)
+            was_holding = self._held_since.pop(name, None) is not None
+            if was_holding and self._ledger is not None:
+                # an evicted/unregistered holder never calls release —
+                # close its ledger hold here or the interval leaks open
+                self._ledger.release(self.chip, now=self._ledger_clock())
             self._shares.pop(name, None)
             self._effective.pop(name, None)
             self._classes.pop(name, None)
@@ -461,7 +475,11 @@ class TokenScheduler:
             self._core.request_token(name)
             self._note_demand(name)
             t0 = time.monotonic()
-            quota = self._wait_for_grant(name, deadline)
+            try:
+                quota = self._wait_for_grant(name, deadline)
+            except TimeoutError:
+                self._note_timeout(name, time.monotonic() - t0, trace_id)
+                raise
             self._note_grant(name, time.monotonic() - t0, trace_id)
             return quota
 
@@ -485,7 +503,11 @@ class TokenScheduler:
             self._note_demand(name)
             self._cond.notify_all()
             t0 = time.monotonic()
-            quota = self._wait_for_grant(name, deadline)
+            try:
+                quota = self._wait_for_grant(name, deadline)
+            except TimeoutError:
+                self._note_timeout(name, time.monotonic() - t0, trace_id)
+                raise
             self._note_grant(name, time.monotonic() - t0, trace_id)
             return quota
 
@@ -571,6 +593,14 @@ class TokenScheduler:
         obs_slo.default_evaluator().record(
             namespace, "grant-wait", value_s=wait_s, trace_id=trace_id)
         self._held_since[name] = time.monotonic()
+        if self._ledger is not None:
+            now = self._ledger_clock()
+            if self._blame is not None and wait_s > 0.0:
+                # attribute BEFORE recording the grant: the wait window
+                # must see the previous occupants, not this grant
+                self._blame.account_wait(self.chip, namespace, tpu_class,
+                                         wait_s, now=now, trace_id=trace_id)
+            self._ledger.grant(self.chip, namespace, tpu_class, now=now)
         if trace_id:
             tracer = get_tracer()
             end = tracer.now_ms()
@@ -578,12 +608,24 @@ class TokenScheduler:
                           end - wait_s * 1000.0, end,
                           client=name, chip=self.chip)
 
+    def _note_timeout(self, name: str, wait_s: float, trace_id: str) -> None:
+        # caller holds self._cond; the wait ended in TimeoutError — the
+        # blocked time is just as real as a granted wait, so the blame
+        # graph still names whoever occupied the chip during it.
+        if self._blame is not None and wait_s > 0.0:
+            self._blame.account_wait(
+                self.chip, name.partition("/")[0],
+                self._classes.get(name, "best-effort"), wait_s,
+                now=self._ledger_clock(), trace_id=trace_id, granted=False)
+
     def _note_release(self, name: str) -> None:
         # caller holds self._cond, AFTER release_token so the utilization
         # gauge includes the usage interval just reported
         since = self._held_since.pop(name, None)
         if since is not None:
             _HOLD.observe(self.chip, value=time.monotonic() - since)
+        if self._ledger is not None:
+            self._ledger.release(self.chip, now=self._ledger_clock())
         # black-box cadence (rate-limited inside): what this token was
         # doing in the run-up to a trigger
         flight_default_recorder().sample_deltas("tokensched-" + self.chip, {
@@ -601,6 +643,16 @@ class TokenScheduler:
             self._core.release_token(name, used_ms, self._clock())
             self._note_release(name)
             self._cond.notify_all()
+
+    def execute_begin(self) -> None:
+        """An execute started under the current hold (proxy ``_gated``)
+        — flips the ledger interval to granted-active."""
+        if self._ledger is not None:
+            self._ledger.execute_begin(self.chip, now=self._ledger_clock())
+
+    def execute_end(self) -> None:
+        if self._ledger is not None:
+            self._ledger.execute_end(self.chip, now=self._ledger_clock())
 
     def window_usage(self, name: str) -> float:
         with self._cond:

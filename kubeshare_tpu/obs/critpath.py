@@ -199,6 +199,18 @@ def assemble(spans: Sequence[dict],
             segments[seg] += _interval_union_ms(intervals)
         for env, inner in ENVELOPES.items():
             if segments.get(env, 0.0) > 0.0:
+                inner_present = any(
+                    seg in inner for (_, seg) in buckets)
+                if not inner_present:
+                    # The envelope is client-measured round-trip time;
+                    # without the server-side spans it carried (proxy
+                    # never pushed its export) we cannot split wire time
+                    # from service time. Attributing the whole RTT to
+                    # transport would blame the network for chip work —
+                    # drop the segment to residual so coverage degrades
+                    # honestly instead of misattributing.
+                    segments[env] = 0.0
+                    continue
                 carried = sum(segments.get(i, 0.0) for i in inner)
                 segments[env] = max(0.0, segments[env] - carried)
         attributed = min(sum(segments.values()), wall_ms)

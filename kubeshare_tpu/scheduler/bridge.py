@@ -303,6 +303,16 @@ class ServiceClient:
             raise RuntimeError(f"/gangs returned {code}")
         return body
 
+    def ledger(self) -> dict:
+        """Chip-time ledger + blame graph (``GET /ledger``,
+        doc/observability.md): per-chip interval accounting and
+        per-(victim, blamed, chip) wait attribution. RuntimeError when
+        the scheduler predates the contention plane."""
+        code, body = self._call("GET", "/ledger")
+        if code != 200:
+            raise RuntimeError(f"/ledger returned {code}")
+        return body
+
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
 

@@ -34,6 +34,9 @@ API (JSON):
   dump (always-on bounded ring; dumped on alert/eviction/crash triggers)
 - ``GET  /gangs``     gang isolation plane: every bound gang's membership,
   grant state, and grant-wait percentiles (doc/gang.md)
+- ``GET  /ledger``    chip-time ledger + blame graph: per-chip interval
+  accounting and per-(victim, blamed, chip) wait attribution
+  (doc/observability.md, contention attribution)
 - ``GET  /healthz``
 
 Overload shedding: with ``max_pending`` set, ``POST /schedule`` answers
@@ -87,11 +90,18 @@ class SchedulerService:
         # declared objectives evaluation is a no-op over an empty dict
         self.slo = obs_slo.default_evaluator()
         self.dispatcher.attach_slo(self.slo)
+        # contention attribution plane (doc/observability.md): the
+        # process-global chip-time ledger + blame graph back GET /ledger
+        # and topcli --why; always on, empty until hooks feed them
+        from ..obs.blame import default_blame
+        from ..obs.ledger import default_ledger
+        self.ledger = default_ledger()
+        self.blame = default_blame()
         # gang isolation plane (doc/gang.md): the dispatcher publishes
         # every bound gang's membership here; with no gangs the
         # coordinator is an empty snapshot
         from ..gang import GangTokenCoordinator
-        self.gangcoord = GangTokenCoordinator()
+        self.gangcoord = GangTokenCoordinator(ledger=self.ledger)
         self.dispatcher.attach_gang_coordinator(self.gangcoord)
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
@@ -223,6 +233,15 @@ class SchedulerService:
         snap["count"] = len(snap["gangs"])
         return snap
 
+    def ledger_state(self) -> dict:
+        """``GET /ledger`` body: per-chip time accounting (current
+        state, per-state sums, recent intervals) plus the blame graph's
+        wait-attribution edges (doc/observability.md)."""
+        snap = self.ledger.snapshot()
+        snap["attached"] = True
+        snap["blame"] = self.blame.state()
+        return snap
+
     def flightrecorder_state(self) -> dict:
         """``GET /flightrecorder`` body: ring summary + latest dump."""
         rec = obs_flight.default_recorder()
@@ -335,6 +354,8 @@ class SchedulerService:
                     return self._reply(200, svc.invariants_state())
                 if self.path == "/gangs":
                     return self._reply(200, svc.gangs_state())
+                if self.path == "/ledger":
+                    return self._reply(200, svc.ledger_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})

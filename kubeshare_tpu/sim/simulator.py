@@ -18,6 +18,7 @@ others request whole chips (request = limit = n).
 from __future__ import annotations
 
 import heapq
+import math
 import random
 from dataclasses import dataclass, field
 
@@ -171,6 +172,122 @@ def simulate_critpath(n_requests: int, seed: int = 0,
     spans = critpath.spans_from_flight_entries(rows, source="sim")
     traces = critpath.assemble(spans)
     return {"report": critpath.report(traces), "traces": traces}
+
+
+def simulate_contention(n_requests: int, seed: int = 0,
+                        qps: float = 25.0) -> dict:
+    """Deterministic virtual-time contention replay for the chip-time
+    ledger + blame graph (doc/observability.md).
+
+    One exclusive chip token, two tenants: ``tenant-lat`` (class
+    ``latency``, seeded Poisson arrivals of short requests) and
+    ``tenant-flood`` (class ``best-effort``, work-conserving — it
+    re-requests the token the moment it releases, modulo a short think
+    gap). The token is non-preemptible, so every latency arrival that
+    lands mid-flood waits out the residual hold; the replay feeds each
+    wait window to :class:`~..obs.blame.BlameGraph` against a
+    virtual-clock :class:`~..obs.ledger.ChipTimeLedger` and checks the
+    ledger's conservation property at the end. Flood holds bracket an
+    execute window inside the hold, so the run exercises
+    granted-active, granted-idle, and free states.
+
+    Everything derives from ``seed`` in virtual time: two runs produce
+    byte-identical JSON — the determinism the CI replay gate and
+    ``sim --contention`` lean on.
+    """
+    from ..obs.blame import BlameGraph
+    from ..obs.ledger import ChipTimeLedger
+
+    rng = random.Random(seed)
+    chip = "sim-chip-0"
+    vclock = [0.0]
+    ledger = ChipTimeLedger(clock=lambda: vclock[0])
+    blame = BlameGraph(ledger=ledger)
+
+    # precomputed latency-tenant arrivals (Poisson) and service times
+    arrivals = []
+    t_a = 0.0
+    for i in range(n_requests):
+        t_a += rng.expovariate(qps)
+        arrivals.append((t_a, rng.uniform(0.004, 0.02), i))
+
+    lat_waits: list[float] = []
+    flood_holds = 0
+    t = 0.0                      # time the chip token is next free
+    flood_ready_at = 0.0         # when flood's standing request arrived
+    i = 0                        # next unserved latency arrival
+
+    def serve(tenant, tpu_class, grant_t, requested_t, hold_s, trace_id,
+              exec_frac=1.0):
+        """Grant at grant_t, execute exec_frac of the hold centred in
+        it, release — attributing the wait before the grant so the
+        blame window sees the previous occupants."""
+        nonlocal t
+        vclock[0] = grant_t
+        wait_s = grant_t - requested_t
+        if wait_s > 0.0:
+            blame.account_wait(chip, tenant, tpu_class, wait_s,
+                               now=grant_t, trace_id=trace_id)
+        ledger.grant(chip, tenant, tpu_class, now=grant_t)
+        lead = hold_s * (1.0 - exec_frac) / 2.0
+        ledger.execute_begin(chip, now=grant_t + lead)
+        ledger.execute_end(chip, now=grant_t + hold_s - lead)
+        t = grant_t + hold_s
+        vclock[0] = t
+        ledger.release(chip, now=t)
+        return wait_s
+
+    while i < len(arrivals):
+        next_lat = arrivals[i][0]
+        if next_lat <= t:
+            # a latency request is waiting: it outranks the flood
+            arr, svc, idx = arrivals[i]
+            i += 1
+            lat_waits.append(serve("tenant-lat", "latency", t, arr, svc,
+                                   f"sim-lat-{seed}-{idx:04d}",
+                                   exec_frac=0.9))
+        elif flood_ready_at <= t:
+            # flood is waiting (or ready right now): it takes the token
+            grant_t = t
+            hold = rng.uniform(0.04, 0.22)
+            serve("tenant-flood", "best-effort", grant_t, flood_ready_at,
+                  hold, f"sim-flood-{seed}-{flood_holds:04d}",
+                  exec_frac=0.8)
+            flood_holds += 1
+            flood_ready_at = t + rng.uniform(0.0, 0.01)  # think gap
+        else:
+            # chip is free: advance to whichever request lands first
+            t = min(next_lat, flood_ready_at)
+
+    vclock[0] = t
+    violations = ledger.check(now=t)
+    waits = sorted(lat_waits)
+
+    def pct(q):
+        if not waits:
+            return 0.0
+        return waits[min(len(waits) - 1,
+                         max(0, math.ceil(q * len(waits)) - 1))]
+
+    return {
+        "requests": n_requests,
+        "seed": seed,
+        "virtual_elapsed_s": round(t, 6),
+        "flood_holds": flood_holds,
+        "latency_waits": len([w for w in lat_waits if w > 0.0]),
+        "latency_wait_p50_s": round(pct(0.50), 6),
+        "latency_wait_p99_s": round(pct(0.99), 6),
+        "latency_waited_s": round(sum(lat_waits), 6),
+        "conservation": {
+            c: {k: (round(v, 6) if isinstance(v, float)
+                    else ({s: round(x, 6) for s, x in v.items()}
+                          if isinstance(v, dict) else v))
+                for k, v in rep.items()}
+            for c, rep in ledger.conservation(now=t).items()},
+        "violations": violations,
+        "top_blamed": blame.top_blamed("tenant-lat"),
+        "blame": blame.state(),
+    }
 
 
 @dataclass
@@ -595,6 +712,15 @@ def main(argv=None) -> None:
                         help="with --critpath: also export each "
                              "synthetic process's spans to DIR/<source>"
                              ".jsonl for topcli --critpath --spans")
+    parser.add_argument("--contention", type=int, default=0, metavar="N",
+                        help="replay N latency-tenant requests against a "
+                             "work-conserving best-effort flooder on one "
+                             "shared chip in virtual time, feeding the "
+                             "chip-time ledger + blame graph (doc/"
+                             "observability.md) and printing the "
+                             "machine-readable report: wait percentiles, "
+                             "ranked blame, ledger conservation — "
+                             "deterministic per --seed")
     parser.add_argument("--chaos", action="store_true",
                         help="run the deterministic chaos-scenario "
                              "suite (kubeshare_tpu/chaos, doc/chaos.md) "
@@ -608,9 +734,15 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
 
     if sum(map(bool, (args.synthetic, args.trace, args.churn,
-                      args.serve, args.critpath, args.chaos))) != 1:
+                      args.serve, args.critpath, args.chaos,
+                      args.contention))) != 1:
         parser.error("exactly one of --trace / --synthetic / --churn "
-                     "/ --serve / --critpath / --chaos is required")
+                     "/ --serve / --critpath / --chaos / --contention "
+                     "is required")
+    if args.contention:
+        out = simulate_contention(args.contention, seed=args.seed)
+        print(json.dumps({"contention": out}, sort_keys=True))
+        return
     if args.chaos:
         from ..chaos import run_suite
 

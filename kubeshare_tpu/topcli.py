@@ -13,7 +13,7 @@ Usage::
                                    [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json] [--latency]
                                    [--health] [--autopilot] [--serving]
-                                   [--gangs] [--fleet]
+                                   [--gangs] [--fleet] [--why TARGET]
                                    [--critpath --spans PATH ...]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
@@ -50,6 +50,11 @@ scheduler's ``/serving``, joined with the registry's capacity view.
 ``--gangs`` renders the gang isolation plane (``doc/gang.md``): each
 co-scheduled gang's membership, grant state, and gang grant-wait
 p50/p99 from the scheduler's ``/gangs``.
+``--why POD_OR_TENANT`` renders the contention-attribution report
+(``doc/observability.md``): the scheduler's ``/ledger`` chip-time
+intervals and blame edges joined with SLO burn state, gang pause
+windows and eviction history — a ranked "your waits went to tenant Y
+holding chip Z for W seconds" explanation.
 Exit 0 on a healthy read, 2 when the registry is unreachable.
 """
 
@@ -373,6 +378,21 @@ FLEET_PANELS = (
      "avg", None, "ratio"),
     ("pending pods", "kubeshare_scheduler_pending_pods",
      "sum", None, ""),
+    ("gang wait p99", "kubeshare_gang_grant_wait_seconds",
+     "quantile", 0.99, "s"),
+    ("blame wait rate", "kubeshare_blame_wait_seconds_total",
+     "rate", None, "s/s"),
+)
+
+#: (label, family, agg, q, group_label, unit) — the --fleet GANGS panel
+#: (the PR 10 gang grant families, grouped per gang registry-side)
+FLEET_GANG_PANELS = (
+    ("wait p99", "kubeshare_gang_grant_wait_seconds",
+     "quantile", 0.99, "gang", "s"),
+    ("partials", "kubeshare_gang_partial_releases_total",
+     "increase", None, "gang", ""),
+    ("paused", "kubeshare_gang_paused",
+     "latest", None, "gang", ""),
 )
 
 #: (label, family, agg) — panels that get sparkline history in --watch
@@ -475,6 +495,155 @@ def render_gangs(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def why_snapshot(client: RegistryClient, scheduler, target: str) -> dict:
+    """Causal contention report for one pod or tenant
+    (doc/observability.md, contention attribution): joins the
+    scheduler's ``/ledger`` (chip-time intervals + blame edges), the
+    ``/slo`` burn state, ``/gangs`` pause windows, and ``/evictions``
+    into one ranked "your waits went to tenant Y on chip Z" report.
+    The blame victim key is the tenant namespace, so a ``ns/pod``
+    target reports its namespace's attribution."""
+    tenant = target.partition("/")[0]
+    out: dict = {"target": target, "tenant": tenant, "available": False,
+                 "victim": {}, "ranked": [], "chips": {}, "slo": [],
+                 "serving": {}, "paused_gangs": [], "evictions": []}
+    if scheduler is None:
+        return out
+    try:
+        ledger = scheduler.ledger()
+    except Exception as exc:
+        print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+              "ledger unavailable", file=sys.stderr)
+        return out
+    out["available"] = True
+    blame = ledger.get("blame", {})
+    out["victim"] = blame.get("victims", {}).get(tenant, {})
+    agg: dict[str, dict] = {}
+    for e in blame.get("edges", []):
+        if e.get("victim") != tenant:
+            continue
+        rec = agg.setdefault(e["blamed"], {
+            "blamed": e["blamed"], "wait_s": 0.0, "count": 0,
+            "chips": set(), "gangs": set(), "trace_ids": []})
+        rec["wait_s"] += e.get("wait_s", 0.0)
+        rec["count"] += e.get("count", 0)
+        rec["chips"].add(e.get("chip", ""))
+        rec["gangs"].update(e.get("gangs", []))
+        rec["trace_ids"].extend(e.get("trace_ids", []))
+    total = sum(r["wait_s"] for r in agg.values()) or 1.0
+    out["ranked"] = [
+        {"blamed": r["blamed"], "wait_s": round(r["wait_s"], 6),
+         "share": round(r["wait_s"] / total, 4), "count": r["count"],
+         "chips": sorted(r["chips"]), "gangs": sorted(r["gangs"]),
+         "trace_ids": r["trace_ids"][-4:]}
+        for r in sorted(agg.values(), key=lambda r: -r["wait_s"])]
+    chips = ledger.get("chips", {})
+    relevant = {c for r in out["ranked"] for c in r["chips"]}
+    relevant |= {cid for cid, c in chips.items()
+                 if c.get("tenant") == tenant}
+    out["chips"] = {cid: chips[cid]
+                    for cid in sorted(relevant) if cid in chips}
+    try:
+        out["slo"] = scheduler.slo().get("tenants", {}).get(tenant, [])
+    except Exception:
+        pass                      # plane predates /slo — partial report
+    try:
+        serving = scheduler.serving()
+        if serving.get("attached"):
+            # serving accounting join: the request-side symptom of the
+            # chip-side contention the ledger attributes
+            out["serving"] = serving.get("tenants", {}).get(tenant, {})
+    except Exception:
+        pass
+    try:
+        gangs = scheduler.gangs().get("gangs", {})
+        out["paused_gangs"] = [
+            {"gang": gid, "members": g.get("members", [])}
+            for gid, g in sorted(gangs.items())
+            if g.get("state") == "paused"]
+    except Exception:
+        pass
+    try:
+        out["evictions"] = [
+            e for e in scheduler.evictions()
+            if tenant in str(e.get("victim", ""))
+            or tenant in str(e.get("preemptor", ""))]
+    except Exception:
+        pass
+    return out
+
+
+def render_why(snap: dict) -> str:
+    lines = [f"WHY {snap['target']} (contention attribution, "
+             "doc/observability.md)"]
+    if not snap.get("available"):
+        lines.append("  unavailable — name a scheduler with --scheduler "
+                     "(GET /ledger)")
+        return "\n".join(lines)
+    vic = snap.get("victim") or {}
+    if vic:
+        lines.append(
+            f"  tenant {snap['tenant']}: waited "
+            f"{vic.get('waited_s', 0.0):.3f}s across "
+            f"{vic.get('waits', 0)} grant(s) "
+            f"({vic.get('timeouts', 0)} timed out), "
+            f"{vic.get('attributed_s', 0.0):.3f}s attributed to "
+            "co-tenants")
+    else:
+        lines.append(f"  tenant {snap['tenant']}: no recorded grant "
+                     "waits — nothing to attribute")
+    srv = snap.get("serving") or {}
+    if srv:
+        lines.append(
+            f"  serving: {srv.get('queued', 0)} queued, "
+            f"{srv.get('shed', 0)} shed, p99 "
+            f"{srv.get('p99_ms', 0.0):.1f}ms "
+            f"({srv.get('completed', 0)} completed)")
+    for o in snap.get("slo", []):
+        lines.append(
+            f"  SLO {o.get('objective', '?')}: burn "
+            f"{o.get('burn_fast', 0.0):g}x fast / "
+            f"{o.get('burn_slow', 0.0):g}x slow, "
+            f"{o.get('budget_remaining', 1.0):.0%} budget left"
+            + ("  ** FIRING **" if o.get("firing") else ""))
+    if snap.get("ranked"):
+        lines.append("  RANKED BLAME (who occupied the chip during the "
+                     "waits):")
+        for i, r in enumerate(snap["ranked"], 1):
+            tail = ""
+            if r.get("gangs"):
+                tail += f"  [gang {', '.join(r['gangs'])}]"
+            if r.get("trace_ids"):
+                tail += f"  traces: {', '.join(t[:12] for t in r['trace_ids'][-2:])}"
+            lines.append(
+                f"  {i:>2}. {r['blamed']:<24} {r['wait_s']:>9.3f}s "
+                f"({r['share']:>4.0%}) on {', '.join(r['chips'])}{tail}")
+    if snap.get("chips"):
+        lines.append("  CHIP TIMELINES (per-state seconds since first "
+                     "touch):")
+        for cid, c in snap["chips"].items():
+            by = c.get("by_state", {})
+            mix = "  ".join(f"{s} {by.get(s, 0.0):.2f}s"
+                            for s in ("granted-active", "granted-idle",
+                                      "reserving", "paused", "free")
+                            if by.get(s))
+            holder = (f"{c.get('tenant')} ({c.get('tpu_class') or '?'})"
+                      if c.get("tenant") else c.get("state", "?"))
+            lines.append(f"    {cid:<28} now {c.get('state', '?')} by "
+                         f"{holder} for {c.get('since_s', 0.0):.2f}s")
+            if mix:
+                lines.append(f"      {mix}")
+    for g in snap.get("paused_gangs", []):
+        lines.append(f"  PAUSED gang {g['gang']} "
+                     f"({len(g.get('members', []))} member(s)) — "
+                     "migration flip in progress")
+    for e in snap.get("evictions", []):
+        lines.append(f"  EVICTION: {e.get('victim', '?')} for "
+                     f"{e.get('preemptor', '?')} on "
+                     f"{e.get('node', e.get('chip', '?'))}")
+    return "\n".join(lines)
+
+
 def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
     """Telemetry-plane join: push freshness per instance (``/instances``)
     plus the FLEET_PANELS aggregations — each a single ``GET /query``
@@ -498,10 +667,37 @@ def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
     instances = inst.get("instances", [])
     for i in instances:
         i["rpc_rate"] = rates.get(i["instance"])
+    # GANGS panel (doc/gang.md): the PR 10 gang grant families grouped
+    # per gang — one query per column, registry-side
+    gangs: dict[str, dict] = {}
+    for label, family, agg, q, group, unit in FLEET_GANG_PANELS:
+        try:
+            res = client.query(family, agg=agg, window_s=window_s,
+                               q=q if q is not None else 0.99,
+                               by=(group,))
+        except Exception:
+            continue          # plane not pushing yet; the table stands
+        for g in res.get("groups", []):
+            gid = g["labels"].get(group, "")
+            gangs.setdefault(gid, {})[label] = g["value"]
+    # CONTENTION panel (doc/observability.md): blame wait-seconds per
+    # second, grouped by blamed tenant — who is costing the fleet time
+    contention = []
+    try:
+        res = client.query("kubeshare_blame_wait_seconds_total",
+                           agg="rate", window_s=window_s, by=("blamed",))
+        contention = sorted(
+            ({"blamed": g["labels"].get("blamed", ""),
+              "wait_s_per_s": g["value"]}
+             for g in res.get("groups", []) if g["value"]),
+            key=lambda r: -(r["wait_s_per_s"] or 0.0))
+    except Exception:
+        pass
     return {"now": inst.get("now"),
             "stale_after_s": inst.get("stale_after_s"),
             "window_s": float(window_s),
-            "instances": instances, "panels": panels}
+            "instances": instances, "panels": panels,
+            "gangs": gangs, "contention": contention}
 
 
 def fleet_history(client: RegistryClient, watch_s: float,
@@ -556,6 +752,27 @@ def render_fleet(snap: dict) -> str:
     for p in snap["panels"]:
         lines.append(f"  {p['label']:<16} {_fmt_panel(p['value'], p['unit']):>10}"
                      f"   ({p['series']} series)")
+    gangs = snap.get("gangs") or {}
+    if gangs:
+        lines.append("GANGS (gang-atomic grants, doc/gang.md)")
+        lines.append(f"  {'gang':<28} {'wait p99':>9} {'partials':>9} "
+                     f"{'paused':>7}")
+        for gid in sorted(gangs):
+            g = gangs[gid]
+            wait = g.get("wait p99")
+            partials = g.get("partials")
+            lines.append(
+                f"  {gid:<28} "
+                f"{_fmt_seconds(wait) if wait is not None else '-':>9} "
+                f"{partials if partials is not None else '-':>9} "
+                f"{'yes' if g.get('paused') else 'no':>7}")
+    contention = snap.get("contention") or []
+    if contention:
+        lines.append("CONTENTION (blame wait-seconds per second, by "
+                     "blamed tenant — topcli --why drills in)")
+        for row in contention[:8]:
+            lines.append(f"  {row['blamed']:<28} "
+                         f"{row['wait_s_per_s']:.3f} s/s")
     for label, values in (snap.get("history") or {}).items():
         lines.append(f"  {label:<16} {_sparkline(values)}")
     return "\n".join(lines)
@@ -805,6 +1022,12 @@ def main(argv=None) -> int:
                              "grant state, and gang grant-wait p50/p99 "
                              "(needs --scheduler for /gangs) instead of "
                              "the fleet table")
+    parser.add_argument("--why", default=None, metavar="POD_OR_TENANT",
+                        help="contention attribution: ranked 'who made "
+                             "this pod/tenant wait' report joining the "
+                             "chip-time ledger, blame graph, SLO burn "
+                             "state, gang pause windows and evictions "
+                             "(needs --scheduler for /ledger)")
     parser.add_argument("--fleet", action="store_true",
                         help="remote-write telemetry plane: per-instance "
                              "push freshness + fleet-wide windowed "
@@ -877,6 +1100,10 @@ def main(argv=None) -> int:
                     gs = gangs_snapshot(client, scheduler)
                     out = (json.dumps(gs) if args.json
                            else render_gangs(gs))
+                elif args.why:
+                    ws = why_snapshot(client, scheduler, args.why)
+                    out = (json.dumps(ws) if args.json
+                           else render_why(ws))
                 elif args.health:
                     hs = health_snapshot(client, scheduler)
                     out = json.dumps(hs) if args.json else render_health(hs)

@@ -1,0 +1,283 @@
+"""Contention-attribution benchmark: chip-time ledger conservation and
+blame-graph accuracy on a seeded noisy-neighbour workload
+(doc/observability.md).
+
+Two phases, one JSON object (committed as ``bench_contention.json``):
+
+- **contention** — real time: one exclusive chip token, a latency-class
+  tenant issuing short requests against a work-conserving best-effort
+  flooder, both through the full :class:`TokenScheduler` façade with a
+  fresh :class:`ChipTimeLedger` + :class:`BlameGraph` attached. Gates:
+  the blame graph must name the flooder as the latency tenant's top
+  blamed tenant; the ledger timeline must conserve (per-state sums equal
+  elapsed wall time within 1%, no gaps/overlaps); the latency tenant's
+  attributed wait-seconds must match its
+  ``kubeshare_token_grant_wait_seconds`` histogram sum within 5% — the
+  blame graph and the histogram are two views of the same waits.
+- **sim** — virtual time: ``simulate_contention`` (the ``sim
+  --contention`` replay) on a fixed seed. Gates: byte-identical JSON
+  across two runs (deterministic), zero conservation violations, flooder
+  top-blamed.
+
+Run: ``python scripts/bench_contention.py`` -> JSON on stdout.
+``--baseline FILE`` prints deltas; ``--write FILE`` saves fresh numbers;
+``--check`` exits non-zero unless every bar holds (``make
+bench-contention`` does all three).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CHIP = "bench-contention-chip"
+WINDOW_MS = 400.0
+BASE_QUOTA_MS = 60.0
+MIN_QUOTA_MS = 5.0
+PHASE_S = 2.0            # wall seconds for the real-time phase
+FLOOD_HOLD_S = 0.02      # flooder hold per grant
+LAT_HOLD_S = 0.002       # latency tenant hold per grant
+LAT_PERIOD_S = 0.008     # latency tenant think time between requests
+EQUIVALENCE_BAR = 0.05   # blame vs histogram relative gap
+SIM_SEED = 11
+SIM_REQUESTS = 400
+
+_HIGHER_IS_BETTER = ("contention.lat_grants", "contention.flood_holds")
+
+
+# --------------------------------------------------------------------------
+# phase 1: real-time noisy neighbour through the TokenScheduler façade
+# --------------------------------------------------------------------------
+
+def run_contention() -> dict:
+    from kubeshare_tpu.isolation.tokensched import _GRANT_WAIT, \
+        TokenScheduler
+    from kubeshare_tpu.obs.blame import BlameGraph
+    from kubeshare_tpu.obs.ledger import ChipTimeLedger
+
+    ledger = ChipTimeLedger()
+    blame = BlameGraph(ledger=ledger)
+    sched = TokenScheduler(WINDOW_MS, BASE_QUOTA_MS, MIN_QUOTA_MS,
+                           chip=CHIP, ledger=ledger, blame=blame)
+    sched.add_client("flood/pod-0", 0.5, 0.9, tpu_class="best-effort")
+    sched.add_client("lat/pod-0", 0.45, 0.5, tpu_class="latency")
+
+    stop = threading.Event()
+    counts = {"flood": 0, "lat": 0}
+    lat_waits: list[float] = []
+
+    def flooder():
+        # work-conserving: re-request the moment the hold ends, so the
+        # latency tenant's waits happen against an occupied chip
+        while not stop.is_set():
+            try:
+                sched.acquire("flood/pod-0", timeout=0.5)
+            except TimeoutError:
+                continue
+            sched.execute_begin()
+            time.sleep(FLOOD_HOLD_S)
+            sched.execute_end()
+            sched.release("flood/pod-0", FLOOD_HOLD_S * 1000.0)
+            counts["flood"] += 1
+
+    def latency():
+        i = 0
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                sched.acquire("lat/pod-0", timeout=2.0,
+                              trace_id=f"bench-lat-{i:05d}")
+            except TimeoutError:
+                continue
+            lat_waits.append(time.monotonic() - t0)
+            sched.execute_begin()
+            time.sleep(LAT_HOLD_S)
+            sched.execute_end()
+            sched.release("lat/pod-0", LAT_HOLD_S * 1000.0)
+            counts["lat"] += 1
+            i += 1
+            time.sleep(LAT_PERIOD_S)
+
+    threads = [threading.Thread(target=flooder),
+               threading.Thread(target=latency)]
+    for t in threads:
+        t.start()
+    time.sleep(PHASE_S)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    violations = ledger.check()
+    cons = ledger.conservation()[CHIP]
+    sched.close()
+
+    top = blame.top_blamed("lat")
+    victims = blame.victims().get(
+        "lat", {"waited_s": 0.0, "attributed_s": 0.0, "waits": 0})
+    _, hist_sum, hist_count = _GRANT_WAIT.snapshot(CHIP, "lat", "latency")
+    gap = (abs(victims["attributed_s"] - hist_sum) / hist_sum
+           if hist_sum else 0.0)
+    waits = sorted(lat_waits)
+
+    def pct(q):
+        if not waits:
+            return 0.0
+        import math
+        return waits[min(len(waits) - 1,
+                         max(0, math.ceil(q * len(waits)) - 1))]
+
+    return {
+        "phase_s": PHASE_S,
+        "flood_holds": counts["flood"],
+        "lat_grants": counts["lat"],
+        "lat_wait_p50_ms": round(pct(0.50) * 1000.0, 3),
+        "lat_wait_p99_ms": round(pct(0.99) * 1000.0, 3),
+        "top_blamed": top[0]["blamed"] if top else "",
+        "top_blamed_share": top[0]["share"] if top else 0.0,
+        "blame_attributed_s": round(victims["attributed_s"], 6),
+        "hist_wait_sum_s": round(hist_sum, 6),
+        "hist_wait_count": hist_count,
+        "equivalence_gap": round(gap, 4),
+        "conservation_violations": len(violations),
+        "violations": violations[:5],
+        "elapsed_s": round(cons["elapsed_s"], 6),
+        "by_state_s": {s: round(v, 6)
+                       for s, v in cons["by_state"].items()},
+        "transitions": cons["transitions"],
+    }
+
+
+# --------------------------------------------------------------------------
+# phase 2: deterministic virtual-time replay (the sim --contention gate)
+# --------------------------------------------------------------------------
+
+def run_sim() -> dict:
+    from kubeshare_tpu.sim.simulator import simulate_contention
+
+    a = simulate_contention(SIM_REQUESTS, seed=SIM_SEED)
+    b = simulate_contention(SIM_REQUESTS, seed=SIM_SEED)
+    deterministic = (json.dumps(a, sort_keys=True)
+                     == json.dumps(b, sort_keys=True))
+    return {
+        "seed": SIM_SEED,
+        "requests": SIM_REQUESTS,
+        "deterministic": deterministic,
+        "conservation_violations": len(a["violations"]),
+        "top_blamed": (a["top_blamed"][0]["blamed"]
+                       if a["top_blamed"] else ""),
+        "latency_wait_p99_s": a["latency_wait_p99_s"],
+        "virtual_elapsed_s": a["virtual_elapsed_s"],
+    }
+
+
+# --------------------------------------------------------------------------
+# harness
+# --------------------------------------------------------------------------
+
+def run_bench() -> dict:
+    return {"contention": run_contention(), "sim": run_sim()}
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (doc/observability.md)."""
+    bars = [
+        ("contention.top_blamed",
+         out["contention"]["top_blamed"] == "flood",
+         "the blame graph must name the flooder as the latency "
+         "tenant's top blamed tenant"),
+        ("contention.conservation_violations",
+         out["contention"]["conservation_violations"] == 0,
+         "the ledger timeline must conserve: per-state sums equal "
+         "elapsed wall time within 1%, no gaps or overlaps"),
+        ("contention.equivalence_gap",
+         out["contention"]["equivalence_gap"] <= EQUIVALENCE_BAR,
+         f"blame-attributed wait-seconds must match the grant-wait "
+         f"histogram sum within {EQUIVALENCE_BAR:.0%}"),
+        ("contention.lat_grants", out["contention"]["lat_grants"] > 0,
+         "the latency tenant must make progress under the flood"),
+        ("sim.deterministic", out["sim"]["deterministic"],
+         "sim --contention must be byte-identical across runs on one "
+         "seed"),
+        ("sim.conservation_violations",
+         out["sim"]["conservation_violations"] == 0,
+         "the virtual-time replay must conserve too"),
+        ("sim.top_blamed", out["sim"]["top_blamed"] == "tenant-flood",
+         "the replay's blame graph must name its flooder"),
+    ]
+    failed = [f"{name}: {why} (got {_lookup(out, name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _metric_keys(out: dict) -> list:
+    return ["contention.flood_holds", "contention.lat_grants",
+            "contention.lat_wait_p99_ms", "contention.equivalence_gap",
+            "contention.conservation_violations",
+            "sim.conservation_violations", "sim.latency_wait_p99_s"]
+
+
+def _lookup(out: dict, key: str):
+    node = out
+    for part in key.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = _lookup(fresh, key), _lookup(base, key)
+        if new is None or old is None:
+            print(f"#   {key:44s} {old!s:>8} -> {new!s:>8}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:44s} {old!s:>8} -> {new!s:>8}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_contention")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the flooder-blamed, "
+                             "conservation and histogram-equivalence "
+                             "bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,38 @@ def test_transport_envelope_clamps_at_zero():
     assert tr["segments"]["transport"] == 0.0      # never negative
 
 
+def test_transport_without_execute_source_degrades_coverage():
+    """Proxy process never pushed its span export: the client RTT span
+    cannot be split into wire vs service time, so transport must drop
+    to residual (lower coverage) rather than claim the whole 50 ms —
+    which would blame the network for chip work."""
+    rows = [
+        span("submit", "t1", 0.0, 60.0, source="scheduler"),
+        span("transport", "t1", 5_000_000.0, 5_000_050.0, source="client"),
+        # no execute span from any source — chipproxy export missing
+    ]
+    tr = critpath.assemble(rows)[0]
+    assert tr["segments"]["transport"] == 0.0
+    assert tr["segments"]["execute"] == 0.0
+    assert tr["attributed_ms"] == 0.0
+    assert tr["residual_ms"] == 60.0
+    assert tr["coverage"] == 0.0                   # degraded, not faked
+
+
+def test_transport_with_zero_length_execute_span_still_splits():
+    """An execute span that IS present but measured 0 ms is evidence the
+    proxy exported — the envelope subtraction applies (carried = 0),
+    keeping the full RTT on transport legitimately."""
+    rows = [
+        span("submit", "t1", 0.0, 60.0, source="scheduler"),
+        span("transport", "t1", 0.0, 50.0, source="client"),
+        span("execute", "t1", 10.0, 10.0, source="chipproxy"),
+    ]
+    tr = critpath.assemble(rows)[0]
+    assert tr["segments"]["transport"] == 50.0
+    assert tr["attributed_ms"] == 50.0
+
+
 def test_traces_without_root_are_skipped_and_unknown_names_ignored():
     rows = [
         span("filter", "orphan", 0.0, 10.0),

@@ -1,0 +1,390 @@
+"""Contention attribution plane: chip-time ledger, blame graph,
+``GET /ledger``, ``topcli --why`` (doc/observability.md)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubeshare_tpu.chaos import invariants
+from kubeshare_tpu.obs import flight
+from kubeshare_tpu.obs.blame import MIGRATION, BlameGraph
+from kubeshare_tpu.obs.ledger import STATES, ChipTimeLedger
+from kubeshare_tpu.topcli import (fleet_snapshot, render_fleet, render_why,
+                                  why_snapshot)
+
+
+# --------------------------------------------------------------------------
+# ledger state machine + conservation (explicit virtual now throughout)
+# --------------------------------------------------------------------------
+
+def test_ledger_state_machine_partitions_timeline():
+    led = ChipTimeLedger(clock=lambda: 0.0)
+    led.grant("c0", "tenant-a", "latency", now=10.0)   # origin: first touch
+    led.execute_begin("c0", now=12.0)                  # idle 10..12
+    led.execute_end("c0", now=15.0)                    # active 12..15
+    led.release("c0", now=16.0)                        # idle 15..16
+    rep = led.conservation(now=20.0)["c0"]             # free 16..20
+    assert rep["by_state"]["free"] == pytest.approx(4.0)
+    assert rep["by_state"]["granted-idle"] == pytest.approx(3.0)
+    assert rep["by_state"]["granted-active"] == pytest.approx(3.0)
+    assert rep["elapsed_s"] == pytest.approx(10.0)
+    assert rep["accounted_s"] == pytest.approx(10.0)
+    assert rep["gap_s"] == 0.0 and rep["overlap_s"] == 0.0
+    assert led.check(now=20.0) == []
+    snap = led.snapshot(now=20.0)
+    assert snap["states"] == list(STATES)
+    assert snap["chips"]["c0"]["state"] == "free"
+    # closed intervals only — free 16..20 is still the open interval
+    seen = {r["state"] for r in snap["chips"]["c0"]["recent"]}
+    assert {"granted-idle", "granted-active"} <= seen
+
+
+def test_ledger_gang_overlay_states():
+    led = ChipTimeLedger(clock=lambda: 0.0)
+    led.grant("c0", "ns", "guarantee", now=1.0)
+    led.mark_reserving("c0", "ns", "guarantee", gang="ring", now=1.0)
+    led.commit("c0", now=3.0)                          # reserving 1..3
+    led.release("c0", now=4.0)                         # idle 3..4
+    led.pause("c0", now=4.0)
+    led.unpause("c0", now=6.0)                         # paused 4..6
+    rep = led.conservation(now=6.0)["c0"]
+    assert rep["by_state"]["reserving"] == pytest.approx(2.0)
+    assert rep["by_state"]["paused"] == pytest.approx(2.0)
+    assert led.check(now=6.0) == []
+    rows = led.account("c0", 1.0, 3.0, now=6.0)
+    assert rows and rows[0]["gang"] == "ring" \
+        and rows[0]["state"] == "reserving"
+
+
+def test_ledger_conservation_survives_interval_eviction():
+    led = ChipTimeLedger(clock=lambda: 0.0, max_intervals=8)
+    t = 0.0
+    for i in range(50):                 # far beyond the retained deque
+        led.grant("c0", f"t{i % 3}", now=t)
+        led.release("c0", now=t + 0.5)
+        t += 1.0
+    rep = led.conservation(now=t)["c0"]
+    assert rep["accounted_s"] == pytest.approx(rep["elapsed_s"])
+    assert led.check(now=t) == []       # cumulative totals, not the deque
+
+
+def test_chaos_invariant_flags_tampered_ledger():
+    led = ChipTimeLedger(clock=lambda: 0.0)
+    led.grant("c0", "a", now=1.0)
+    led.release("c0", now=2.0)
+    assert invariants.check_ledger_conservation(led, now=5.0) == []
+    led._chips["c0"].totals["free"] += 3.0     # corrupt the accounting
+    found = invariants.check_ledger_conservation(led, now=5.0)
+    assert found and found[0]["invariant"] == "ledger-conservation"
+
+
+# --------------------------------------------------------------------------
+# blame graph
+# --------------------------------------------------------------------------
+
+def test_blame_names_occupant_skips_self_and_free():
+    led = ChipTimeLedger(clock=lambda: 0.0)
+    blame = BlameGraph(ledger=led)
+    led.grant("c0", "flood", "best-effort", now=0.0)
+    led.release("c0", now=6.0)                 # flood held 0..6
+    # victim waited 0..10: 6s against flood, 4s free (unattributed)
+    out = blame.account_wait("c0", "lat", "latency", 10.0, now=10.0,
+                             trace_id="tr-1")
+    assert out == [("flood", pytest.approx(6.0))]
+    # self-occupancy is never blamed
+    led.grant("c0", "lat", "latency", now=10.0)
+    led.release("c0", now=12.0)
+    assert blame.account_wait("c0", "lat", "latency", 2.0, now=12.0) == []
+    edges = blame.edges()
+    assert len(edges) == 1
+    e = edges[0]
+    assert (e["victim"], e["blamed"], e["chip"]) == ("lat", "flood", "c0")
+    assert e["wait_s"] == pytest.approx(6.0)
+    assert e["trace_ids"] == ["tr-1"]
+    vic = blame.victims()["lat"]
+    assert vic["waited_s"] == pytest.approx(12.0)
+    assert vic["attributed_s"] == pytest.approx(6.0)
+    top = blame.top_blamed("lat")
+    assert top[0]["blamed"] == "flood" and top[0]["share"] == 1.0
+
+
+def test_blame_pause_window_attributed_to_migration():
+    led = ChipTimeLedger(clock=lambda: 0.0)
+    blame = BlameGraph(ledger=led)
+    led.pause("c0", now=0.0)
+    led.unpause("c0", now=4.0)
+    out = blame.account_wait("c0", "lat", "latency", 4.0, now=4.0,
+                             granted=False)
+    assert out == [(MIGRATION, pytest.approx(4.0))]
+    assert blame.victims()["lat"]["timeouts"] == 1
+
+
+def test_blame_feeds_flight_recorder_deltas():
+    rec = flight.default_recorder()
+    rec.clear()
+    led = ChipTimeLedger(clock=lambda: 0.0)
+    blame = BlameGraph(ledger=led)
+    led.grant("c0", "flood", now=0.0)
+    led.release("c0", now=1.0)
+    blame.account_wait("c0", "lat", "latency", 1.0, now=1.0)
+    deltas = [e for e in rec.ring()
+              if e["kind"] == "delta" and e["subsystem"] == "contention"]
+    assert deltas, "account_wait must sample contention deltas"
+    assert "blame_wait_s" in deltas[-1]["deltas"]
+
+
+# --------------------------------------------------------------------------
+# token scheduler + gang coordinator integration (real time)
+# --------------------------------------------------------------------------
+
+def test_tokensched_feeds_ledger_and_blame():
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    led = ChipTimeLedger()
+    blame = BlameGraph(ledger=led)
+    sched = TokenScheduler(chip="led-chip", ledger=led, blame=blame)
+    sched.add_client("flood/p", 0.5, 0.9, tpu_class="best-effort")
+    sched.add_client("lat/p", 0.45, 0.5, tpu_class="latency")
+
+    sched.acquire("flood/p")
+    waited = {}
+
+    def victim():
+        t0 = time.monotonic()
+        sched.acquire("lat/p", timeout=5.0, trace_id="tr-v")
+        waited["s"] = time.monotonic() - t0
+        sched.release("lat/p", 1.0)
+
+    t = threading.Thread(target=victim)
+    t.start()
+    time.sleep(0.15)                       # victim blocks against the hold
+    sched.execute_begin()
+    time.sleep(0.02)
+    sched.execute_end()
+    sched.release("flood/p", 50.0)
+    t.join(timeout=5.0)
+    assert "s" in waited and waited["s"] > 0.1
+    edges = blame.edges()
+    assert edges and edges[0]["victim"] == "lat" \
+        and edges[0]["blamed"] == "flood"
+    # the attribution matches the measured wait (chip occupied throughout)
+    assert edges[0]["wait_s"] == pytest.approx(waited["s"], rel=0.25)
+    rep = led.conservation()["led-chip"]
+    assert rep["by_state"]["granted-active"] > 0.0
+    assert led.check() == []
+    # an evicted holder must not leak its interval open
+    sched.acquire("flood/p")
+    sched.remove_client("flood/p")
+    assert led.snapshot()["chips"]["led-chip"]["state"] == "free"
+    sched.close()
+
+
+def test_gang_coordinator_overlays_reserving_and_pause():
+    from kubeshare_tpu.gang import GangTokenCoordinator
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+
+    led = ChipTimeLedger()
+    coord = GangTokenCoordinator(reserve_window_s=0.05,
+                                 backoff_base_s=0.002,
+                                 backoff_max_s=0.02, ledger=led)
+    scheds = {}
+    for i in range(2):
+        chip = f"g-chip-{i}"
+        sched = TokenScheduler(chip=chip, ledger=led)
+        sched.add_client(f"m{i}", 0.5, 0.5)
+        coord.attach_chip(chip, sched)
+        scheds[chip] = sched
+    coord.register_gang("ring", [(f"g-chip-{i}", f"m{i}")
+                                 for i in range(2)],
+                        namespace="ns", tpu_class="guarantee")
+    coord.acquire("ring", timeout=5.0)
+    for chip in scheds:                     # committed: held, not reserving
+        c = led.snapshot()["chips"][chip]
+        assert c["state"] == "granted-idle" and c["gang"] == "ring"
+    coord.release("ring")
+    assert coord.pause("ring", timeout=5.0)
+    for chip in scheds:
+        assert led.snapshot()["chips"][chip]["state"] == "paused"
+    coord.resume("ring")
+    for chip in scheds:
+        assert led.snapshot()["chips"][chip]["state"] == "free"
+    rep = led.conservation()
+    for chip in scheds:
+        # the two-phase window left a reserving interval behind
+        assert rep[chip]["by_state"]["reserving"] > 0.0
+        assert rep[chip]["by_state"]["paused"] > 0.0
+    assert led.check() == []
+    for sched in scheds.values():
+        sched.close()
+
+
+# --------------------------------------------------------------------------
+# GET /ledger + topcli --why / --fleet joins
+# --------------------------------------------------------------------------
+
+def test_scheduler_service_ledger_endpoint(monkeypatch):
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.bridge import ServiceClient
+    from kubeshare_tpu.scheduler.service import SchedulerService
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+
+    registry = TelemetryRegistry()
+    svc = SchedulerService(SchedulerEngine(), registry)
+    srv = svc.serve()
+    try:
+        # feed the process-global ledger/blame the service serves
+        svc.ledger.grant("ep-chip", "flood", "best-effort")
+        svc.ledger.release("ep-chip")
+        svc.blame.account_wait("ep-chip", "lat", "latency", 0.001,
+                               now=svc.ledger._clock())
+        client = ServiceClient(
+            f"http://127.0.0.1:{srv.server_address[1]}", timeout=5.0)
+        body = client.ledger()
+        assert body["attached"] is True
+        assert "ep-chip" in body["chips"]
+        assert body["states"] == list(STATES)
+        assert "edges" in body["blame"]
+    finally:
+        svc.close()
+
+
+class _FakeScheduler:
+    """Duck-typed ServiceClient for the --why join."""
+
+    def __init__(self, ledger_body):
+        self._ledger = ledger_body
+
+    def ledger(self):
+        return self._ledger
+
+    def slo(self):
+        return {"tenants": {"lat": [
+            {"objective": "grant-wait-p99<=5ms", "burn_fast": 20.0,
+             "burn_slow": 8.0, "budget_remaining": 0.4, "firing": True}]}}
+
+    def serving(self):
+        return {"attached": True, "tenants": {
+            "lat": {"queued": 7, "shed": 3, "completed": 120,
+                    "p99_ms": 48.5}}}
+
+    def gangs(self):
+        return {"gangs": {"ring": {"state": "paused",
+                                   "members": ["c0", "c1"]}}}
+
+    def evictions(self):
+        return [{"victim": "lat/pod-0", "preemptor": "flood/pod-9",
+                 "node": "host-0"}]
+
+
+def _ledger_body():
+    return {
+        "attached": True,
+        "states": list(STATES),
+        "chips": {"c0": {"state": "granted-active", "tenant": "flood",
+                         "tpu_class": "best-effort", "gang": "",
+                         "since_s": 1.5, "elapsed_s": 60.0,
+                         "by_state": {"granted-active": 40.0,
+                                      "granted-idle": 5.0,
+                                      "reserving": 0.0, "paused": 2.0,
+                                      "free": 13.0},
+                         "transitions": 44, "recent": []}},
+        "blame": {
+            "edges": [
+                {"victim": "lat", "blamed": "flood", "chip": "c0",
+                 "wait_s": 9.0, "count": 80, "gangs": [],
+                 "trace_ids": ["tr-a", "tr-b"]},
+                {"victim": "lat", "blamed": MIGRATION, "chip": "c0",
+                 "wait_s": 1.0, "count": 2, "gangs": ["ring"],
+                 "trace_ids": []},
+                {"victim": "other", "blamed": "lat", "chip": "c0",
+                 "wait_s": 3.0, "count": 5, "gangs": [],
+                 "trace_ids": []}],
+            "victims": {"lat": {"waited_s": 11.0, "attributed_s": 10.0,
+                                "waits": 82, "timeouts": 2}},
+            "waits_attributed": 87, "attributed_s": 13.0},
+    }
+
+
+def test_topcli_why_ranks_blame_and_joins_planes(capsys):
+    snap = why_snapshot(None, _FakeScheduler(_ledger_body()),
+                        "lat/pod-0")
+    assert snap["available"] and snap["tenant"] == "lat"
+    assert [r["blamed"] for r in snap["ranked"]] == ["flood", MIGRATION]
+    assert snap["ranked"][0]["share"] == pytest.approx(0.9)
+    assert "c0" in snap["chips"]
+    out = render_why(snap)
+    assert "WHY lat/pod-0" in out
+    assert "flood" in out and "90%" in out
+    assert "** FIRING **" in out
+    assert "serving: 7 queued, 3 shed, p99 48.5ms" in out
+    assert "PAUSED gang ring" in out
+    assert "EVICTION: lat/pod-0" in out
+    assert "granted-active 40.00s" in out
+    # unreachable scheduler degrades, not crashes
+    degraded = why_snapshot(None, None, "lat")
+    assert not degraded["available"]
+    assert "unavailable" in render_why(degraded)
+
+
+class _FakeRegistry:
+    """Duck-typed RegistryClient: canned /instances + /query."""
+
+    def instances(self):
+        return {"now": 0.0, "stale_after_s": 15.0,
+                "instances": [{"instance": "i-0", "job": "chipproxy",
+                               "age_s": 1.0, "pushes": 3, "samples": 10,
+                               "stale": False}]}
+
+    def query(self, family, agg=None, window_s=None, q=None, by=()):
+        if family == "kubeshare_blame_wait_seconds_total":
+            if by == ("blamed",):     # the CONTENTION panel's grouping
+                return {"groups": [
+                    {"labels": {"blamed": "flood"}, "value": 0.42},
+                    {"labels": {"blamed": "other"}, "value": 0.01}],
+                    "series_matched": 2}
+            return {"groups": [{"labels": {}, "value": 0.43}],
+                    "series_matched": 2}
+        if family == "kubeshare_gang_grant_wait_seconds" and by:
+            return {"groups": [{"labels": {"gang": "ring"},
+                                "value": 0.012}], "series_matched": 1}
+        if family == "kubeshare_gang_partial_releases_total":
+            return {"groups": [{"labels": {"gang": "ring"}, "value": 0}],
+                    "series_matched": 1}
+        if family == "kubeshare_gang_paused":
+            return {"groups": [{"labels": {"gang": "ring"}, "value": 0.0}],
+                    "series_matched": 1}
+        return {"groups": [{"labels": {}, "value": 1.0}],
+                "series_matched": 1}
+
+
+def test_topcli_fleet_contention_and_gang_panels():
+    snap = fleet_snapshot(_FakeRegistry(), window_s=60.0)
+    assert snap["contention"][0]["blamed"] == "flood"
+    assert snap["gangs"]["ring"]["wait p99"] == 0.012
+    assert any(p["family"] == "kubeshare_blame_wait_seconds_total"
+               for p in snap["panels"])
+    out = render_fleet(snap)
+    assert "CONTENTION" in out and "flood" in out
+    assert "GANGS" in out and "ring" in out
+    assert "0.420 s/s" in out
+
+
+# --------------------------------------------------------------------------
+# sim --contention determinism (the CI replay gate's substrate)
+# --------------------------------------------------------------------------
+
+def test_sim_contention_deterministic_and_conserved():
+    from kubeshare_tpu.sim.simulator import simulate_contention
+
+    a = simulate_contention(120, seed=5)
+    b = simulate_contention(120, seed=5)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["violations"] == []
+    assert a["top_blamed"][0]["blamed"] == "tenant-flood"
+    assert a["latency_waited_s"] > 0.0
+    # the timeline partitions: per-state sums equal elapsed within 1%
+    rep = a["conservation"]["sim-chip-0"]
+    accounted = sum(rep["by_state"].values())
+    assert accounted == pytest.approx(rep["elapsed_s"], rel=0.01)
