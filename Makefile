@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt image clean obs-check
 
 all: native
 
@@ -136,6 +136,16 @@ bench-gang:
 bench-contention:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_contention.py --check \
 		--baseline bench_contention.json --write bench_contention.json
+
+# Preemption-plane bench (doc/isolation-wire.md, doc/gang.md): a
+# latency tenant behind a work-conserving best-effort flooder, single
+# chip and 4-chip gang, with the preemption policy on; --check gates
+# the <10% grant-to-completion p99 inflation, >=90% throughput,
+# >=5x blame-to-flooder collapse, gang-atomicity and never-mid-execute
+# bars, then refreshes bench_preempt.json.
+bench-preempt:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_preempt.py --check \
+		--baseline bench_preempt.json --write bench_preempt.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -121,6 +121,7 @@ class ChaosRunner:
         from ..obs.ledger import ChipTimeLedger
 
         self.autopilot = None
+        self.preempt = None          # PreemptionPolicy once preempt_on
         self.token_scheds: dict = {}
         # per-run chip-time ledger on the virtual clock: every mirrored
         # TokenScheduler and the coordinator feed it, and _sample checks
@@ -175,6 +176,8 @@ class ChaosRunner:
                       C.POD_GROUP_NAME: p["name"],
                       C.POD_GROUP_HEADCOUNT: str(p["headcount"]),
                       C.POD_GROUP_THRESHOLD: "1.0"}
+            if p.get("class"):
+                labels[C.POD_CLASS] = p["class"]
             for i in range(int(p["headcount"])):
                 self.disp.submit("chaos", f"{p['name']}-{i}", dict(labels))
         elif act.action == "delete_prefix":
@@ -208,6 +211,20 @@ class ChaosRunner:
                 p.get("duration_s", 1.0))
         elif act.action == "autopilot_apply":
             self._autopilot_cycle()
+        elif act.action == "preempt_on":
+            from ..preempt import PreemptionPolicy
+
+            kwargs = {}
+            if "grace_ms" in p:
+                kwargs["grace_ms"] = float(p["grace_ms"])
+            self.preempt = PreemptionPolicy(**kwargs)
+            self.gangcoord.preempt = self.preempt
+            if "hold_s" in p:
+                # stretch gang auto-holds past the reserve window so a
+                # blocked latency gang actually reaches its grace bound
+                self.gangcoord.auto_hold_s = float(p["hold_s"])
+            for sched in self.token_scheds.values():
+                sched.preempt = self.preempt
         elif act.action == "serve_submit":
             self._serve_submit(p.get("tenant", "t0"),
                                int(p.get("count", 1)))
@@ -283,7 +300,8 @@ class ChaosRunner:
             if sched is None:
                 sched = TokenScheduler(native=False, clock=self._clock,
                                        chip=chip_id, ledger=self.ledger,
-                                       ledger_clock=self._clock)
+                                       ledger_clock=self._clock,
+                                       preempt=self.preempt)
                 self.token_scheds[chip_id] = sched
                 self.gangcoord.attach_chip(chip_id, sched)
             have = sched.shares()

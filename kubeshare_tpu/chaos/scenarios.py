@@ -11,7 +11,13 @@ MTTR samples — the acceptance bar CI's chaos-matrix gates on.
 Action vocabulary (executed by ``orchestrator.ChaosRunner``):
 
 ``submit``            enqueue fractional pods (params: count, request)
-``submit_gang``       enqueue one gang (params: name, headcount, request)
+``submit_gang``       enqueue one gang (params: name, headcount, request,
+                      optional class — labels the gang's SLO class)
+``preempt_on``        attach a PreemptionPolicy to the gang coordinator
+                      and every mirrored token scheduler (params:
+                      grace_ms, hold_s — optional gang auto-hold
+                      stretch) — enables gang-aware preemption for the
+                      rest of the run
 ``node_down``         lose a node: health veto + eviction
 ``node_up``           node returns healthy
 ``flap``              heartbeat flap: N down/up toggles (params: count,
@@ -223,6 +229,37 @@ def gang_grant_vs_eviction(seed: int) -> Scenario:
         ])
 
 
+def preemption_vs_migration(seed: int) -> Scenario:
+    """A latency gang preempts a best-effort gang on the same sub-mesh
+    while the autopilot migrates and one of the hosts dies — preemption
+    marks must never open a partial-grant window (gang-grant atomicity),
+    the ledger must stay conserved through preempted tails, and the
+    cluster must still reconverge (doc/gang.md)."""
+    r = _rng("preemption-vs-migration", seed)
+    down_at = _j(r, 1.5)
+    return Scenario(
+        "preemption-vs-migration",
+        "gang preemption racing autopilot migration and a node death",
+        [
+            ChaosAction(0.0, "preempt_on",
+                        params={"grace_ms": 50.0, "hold_s": 0.5}),
+            # co-tenant singles keep the rest of the mesh contended
+            ChaosAction(0.0, "submit", params={"count": 2, "request": 0.3}),
+            # 0.6 + 0.4 pack onto the same chips: the latency gang's
+            # sub-mesh fully overlaps the best-effort gang's, so its
+            # coordinated grants contend chip-for-chip
+            ChaosAction(0.1, "submit_gang",
+                        params={"name": "flood-ring", "headcount": 4,
+                                "request": 0.6}),
+            ChaosAction(_j(r, 0.5, 0.2), "submit_gang",
+                        params={"name": "lat-ring", "headcount": 4,
+                                "request": 0.4, "class": "latency"}),
+            ChaosAction(_j(r, 1.0), "autopilot_apply"),
+            ChaosAction(down_at, "node_down", "host-1"),
+            ChaosAction(_j(r, down_at + 3.0), "node_up", "host-1"),
+        ])
+
+
 BUILDERS = {
     "node-crash-flap": node_crash_flap,
     "registry-restart-mid-lease": registry_restart_mid_lease,
@@ -231,6 +268,7 @@ BUILDERS = {
     "park-during-migration": park_during_migration,
     "partition-during-gang-bind": partition_during_gang_bind,
     "gang-grant-vs-eviction": gang_grant_vs_eviction,
+    "preemption-vs-migration": preemption_vs_migration,
 }
 
 

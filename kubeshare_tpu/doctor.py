@@ -345,6 +345,45 @@ def check_ledger(addr: str, timeout_s: float,
         f"{edges} blame edge(s)")
 
 
+def check_preempt(addr: str, timeout_s: float,
+                  defaulted: bool = False) -> bool:
+    """Preemption-plane probe (doc/isolation-wire.md): ``/preempt``
+    must answer; when a policy is attached its class ladder must rank
+    ``latency`` above ``best-effort`` (otherwise SLO classes are
+    decorative) — a detached policy is a valid deployment, not a
+    failure."""
+    if not addr or addr == "none":
+        return _result("preempt", "skip", "--scheduler none")
+    try:
+        snap = json.loads(_get(f"http://{addr}/preempt", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("preempt", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("preempt", "skip",
+                           "scheduler predates /preempt")
+        return _result("preempt", "fail", f"{addr}: {exc}")
+    if not snap.get("attached"):
+        return _result("preempt", "ok",
+                       f"{addr}: no policy attached "
+                       "(preemption disabled — scheduler runs pure FIFO"
+                       "/stride)")
+    ladder = snap.get("class_priority", {})
+    if ladder.get("latency", 0) <= ladder.get("best-effort", 0):
+        return _result(
+            "preempt", "fail",
+            "class ladder does not rank latency above best-effort "
+            f"({ladder}) — SLO classes are decorative")
+    stats = snap.get("stats", {})
+    return _result(
+        "preempt", "ok",
+        f"{addr}: policy attached (grace {snap.get('grace_ms')}ms), "
+        f"{stats.get('preemptions', 0)} preemption(s), "
+        f"{stats.get('yields', 0)} boundary yield(s)")
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -591,6 +630,7 @@ def main(argv=None) -> int:
     ok &= check_invariants(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_gangs(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_ledger(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_preempt(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

@@ -91,8 +91,12 @@ class _Gang:
     backoff_until: float = 0.0
     attempts: int = 0
     paused: bool = False
+    #: the preemption plane asked this gang to yield its full hold at
+    #: the next program boundary (gang-atomic preemption)
+    preempt_requested: bool = False
     grants: int = 0
     partial_releases: int = 0
+    preemptions: int = 0               # times this gang was preempted
     waits: deque = field(default_factory=lambda: deque(maxlen=256))
 
 
@@ -109,7 +113,7 @@ class GangTokenCoordinator:
     def __init__(self, reserve_window_s: float = 0.25,
                  backoff_base_s: float = 0.01, backoff_max_s: float = 0.2,
                  clock=None, used_scale: float = 1000.0, rng=None,
-                 auto_hold_s: float = 0.05, ledger=None):
+                 auto_hold_s: float = 0.05, ledger=None, preempt=None):
         self.reserve_window_s = reserve_window_s
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
@@ -126,6 +130,12 @@ class GangTokenCoordinator:
         #: two-phase ``reserving`` window, the commit, and migration
         #: pause windows — on this clock (seconds, same as ``clock``).
         self._ledger = ledger
+        #: preemption policy (kubeshare_tpu.preempt). Gang preemption
+        #: routes through the same two-phase machinery: the decision is
+        #: made once for the whole gang under ``self._lock`` and the
+        #: per-chip marks/boosts are issued in sorted chip order — no
+        #: partial-preemption window, no hold-and-wait cycle.
+        self.preempt = preempt
         self._rng = rng or random.Random(0xD1CE)
         self._lock = threading.Condition()
         self._scheds: dict[str, object] = {}
@@ -261,7 +271,14 @@ class GangTokenCoordinator:
                 if deadline is not None and time.monotonic() >= deadline:
                     raise TimeoutError(
                         f"gang {gang_id}: grant wait timed out ({failure})")
-                self._backoff_sleep(g.attempts, deadline)
+                if not self._maybe_preempt_blockers(g):
+                    # no victim fired or draining for this plan: plain
+                    # contention, back off. With a preemption in flight
+                    # retry at once instead — the waiter must be parked
+                    # on its anchor chip when the victim yields, so the
+                    # directed grant lands on a live request rather
+                    # than being skipped for a work-conserving rival.
+                    self._backoff_sleep(g.attempts, deadline)
                 continue
             committed = False
             with self._lock:
@@ -295,6 +312,13 @@ class GangTokenCoordinator:
                 return f"chip {chip} not attached"
             if i == 0:
                 per = self._remaining(deadline)
+                if self.preempt is not None and self.preempt.enabled:
+                    # with preemption on, the anchor chip's wait is
+                    # bounded by the reserve window too: the failure
+                    # path must come back around so the blocked gang's
+                    # grace clock can trigger _maybe_preempt_blockers
+                    per = (self.reserve_window_s if per is None
+                           else min(per, self.reserve_window_s))
             else:
                 per = self.reserve_window_s
                 rem = self._remaining(deadline)
@@ -357,6 +381,7 @@ class GangTokenCoordinator:
         with self._lock:
             g.held = {}
             g.state = "idle"
+            g.preempt_requested = False
             if was_partial:
                 g.partial_releases += 1
             self._lock.notify_all()
@@ -377,6 +402,74 @@ class GangTokenCoordinator:
             used_ms = hold_s * self.used_scale
         self._release_held(g, used=used_ms)
         _GANG_HOLD.observe(gang_id, value=hold_s)
+
+    # -- gang-atomic preemption (kubeshare_tpu.preempt) ---------------
+
+    def preempted(self, gang_id: str) -> bool:
+        """Has the preemption plane asked *gang_id* to yield its hold?
+        The gang runner's program-boundary check — the gang-level
+        analogue of ``TokenScheduler.preempted`` (auto-drive releases
+        such a gang itself on the next step)."""
+        with self._lock:
+            g = self._gangs.get(gang_id)
+            return bool(g is not None and g.preempt_requested)
+
+    def _maybe_preempt_blockers(self, g: _Gang) -> bool:
+        """A reserve attempt by *g* failed: if the policy says *g*'s
+        class outranks a gang holding chips in *g*'s plan past grace,
+        preempt that gang ATOMICALLY — one decision for the whole gang
+        under ``self._lock``, then per-chip marks and directed grants
+        issued in sorted chip order without the lock (the same total
+        order and lock discipline as every other gang operation, so no
+        hold-and-wait cycle and no partial-preemption window: the
+        victim's members yield via their normal full-set release).
+        Returns True when a victim fired now or is still draining a
+        prior request overlapping *g*'s plan — the caller then retries
+        without backoff so it is waiting when the victim yields."""
+        policy = self.preempt
+        if policy is None or not policy.enabled:
+            return False
+        now = self._clock()
+        actions: list[tuple[str, str, str]] = []
+        victims: list[str] = []
+        draining = False
+        with self._lock:
+            waited_ms = max(0.0, now - g.reserve_started) * 1000.0
+            plan = dict(self._reserve_plan(g.members))
+            for b in self._gangs.values():
+                if b.gang_id == g.gang_id or b.state != "held":
+                    continue
+                overlap = sorted(set(plan) & set(b.held))
+                if not overlap:
+                    continue
+                if b.preempt_requested:
+                    draining = True    # already asked; it is draining
+                    continue
+                held_ms = max(0.0, now - b.held_since) * 1000.0
+                if not policy.should_preempt(g.tpu_class, b.tpu_class,
+                                             waited_ms, held_ms):
+                    continue
+                b.preempt_requested = True
+                b.preemptions += 1
+                victims.append(b.gang_id)
+                for chip in overlap:
+                    actions.append((chip, b.held[chip][0], plan[chip]))
+        for chip, holder_client, beneficiary in sorted(actions):
+            with self._lock:
+                sched = self._scheds.get(chip)
+            if sched is None:
+                continue
+            mark = getattr(sched, "mark_preempted", None)
+            if mark is not None:
+                mark(holder_client)
+            boost = getattr(sched, "add_boost", None)
+            if boost is not None:
+                boost(beneficiary)
+        for victim in victims:
+            policy.note_gang_preemption(victim, g.gang_id)
+            log.debug("gang %s preempted for %s-class gang %s", victim,
+                      g.tpu_class, g.gang_id)
+        return bool(victims) or draining
 
     def _note_grant(self, gang_id: str, namespace: str, tpu_class: str,
                     wait_s: float, held: dict, trace_id: str) -> None:
@@ -512,7 +605,11 @@ class GangTokenCoordinator:
         if state == "paused":
             return
         if state == "held":
-            if now - g.held_since >= self.auto_hold_s or g.paused:
+            # a preempt-requested hold yields at the next step — the
+            # virtual-time program boundary (usage charged for the time
+            # actually held; the remaining quantum is forfeited)
+            if (now - g.held_since >= self.auto_hold_s or g.paused
+                    or g.preempt_requested):
                 self.release(g.gang_id)
             return
         # reserving: try-acquire every missing chip token this tick
@@ -556,6 +653,7 @@ class GangTokenCoordinator:
                             self.backoff_base_s * (2 ** min(attempt, 10)))
                 delay *= 0.5 + self._rng.random()
             self._release_held(g, used=0.0, partial=True)
+            self._maybe_preempt_blockers(g)
             with self._lock:
                 g.backoff_until = now + delay
 
@@ -591,6 +689,8 @@ class GangTokenCoordinator:
                     "held": sorted(g.held),
                     "grants": g.grants,
                     "partial_releases": g.partial_releases,
+                    "preemptions": g.preemptions,
+                    "preempt_requested": g.preempt_requested,
                     "grant_wait_p50_ms": _percentile(waits, 0.50) * 1e3,
                     "grant_wait_p99_ms": _percentile(waits, 0.99) * 1e3,
                 }

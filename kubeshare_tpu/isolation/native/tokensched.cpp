@@ -198,7 +198,11 @@ int ts_poll(void* h, double now_ms, char* name_out, int name_cap,
       *next_wake_ms_out = std::min(*next_wake_ms_out, t);
       continue;
     }
-    if (best == nullptr || c.vtime < best->vtime) {
+    // Lexicographic name tie-break on equal vtime: without it the winner
+    // falls to unordered_map iteration order, which drifts from the
+    // Python core (dict insertion order) on fresh equal-vtime waiters.
+    if (best == nullptr || c.vtime < best->vtime ||
+        (c.vtime == best->vtime && c.name < best->name)) {
       best = &c;
       best_remaining = remaining;
     }

@@ -65,7 +65,7 @@ RID_KEY = "_rid"
 ACK_KEY = "_ack"
 
 #: transport features this build can negotiate at register time.
-FEATURES = ("resume", "seq")
+FEATURES = ("resume", "seq", "preempt")
 
 #: per-connection server credit: requests accepted off the wire but not
 #: yet replied to. Bounds the dispatch queue AND the reply queue, so a

@@ -41,6 +41,7 @@ from ..obs.flight import default_recorder as flight_default_recorder
 from ..resilience import faults as _faults
 from ..resilience.journal import SessionJournal
 from ..utils.logger import get_logger
+from ..preempt.slicer import BoundarySlicer
 from . import protocol
 from .protocol import load_array
 from .tokensched import TokenScheduler
@@ -181,6 +182,9 @@ class _Session:
     #: workload class (sharedtpu/class) propagated at register — tags the
     #: token scheduler's per-tenant grant-wait series
     tpu_class: str = "best-effort"
+    #: program-boundary yields this session performed after its hold was
+    #: marked preempted (surfaced in chain replies when negotiated)
+    preempt_yields: int = 0
     # -- resilience state (resumable sessions only) ---------------------
     #: features negotiated at register; frozen for the session's lifetime
     features: frozenset = frozenset()
@@ -297,6 +301,11 @@ class ChipProxy:
                           else TokenScheduler(chip=str(self.device),
                                               ledger=default_ledger(),
                                               blame=default_blame()))
+        # program-boundary slicing (preempt/slicer.py): between token-
+        # gated bursts the proxy asks whether its hold was preempted and
+        # yields via renew — never mid-execute (the slicer refuses while
+        # an execute is in flight and its stats prove it)
+        self.slicer = BoundarySlicer(self.scheduler)
         self.idle_release_ms = idle_release_ms
         self.detach_grace_ms = detach_grace_ms
         self.journal = SessionJournal(journal_dir)
@@ -639,17 +648,29 @@ class ChipProxy:
         collapsing request-weighted shares to round-robin (the same hazard
         ``TokenScheduler.renew`` documents). Idle clients return the token
         via the idle timer instead.
+
+        A hold marked preempted (``TokenScheduler.preempted``) yields
+        here too — this gate sits exactly at a program boundary, so the
+        renew forfeits the remaining quantum without ever interrupting
+        an execute; the directed-grant queue hands the token to the
+        higher-class beneficiary and then straight back.
         """
         with sess.lock:
             sess.busy = True
             holding = sess.holding
             exhausted = holding and sess.used_ms >= sess.quota_ms
             used = sess.used_ms
+        preempted = (holding and not exhausted
+                     and self.slicer.should_yield(sess.name))
         try:
             if not holding:
                 quota = self.scheduler.acquire(sess.name,
                                                trace_id=sess.trace_id)
-            elif exhausted:
+            elif exhausted or preempted:
+                if preempted:
+                    self.slicer.note_yield(sess.name)
+                    with sess.lock:
+                        sess.preempt_yields += 1
                 quota = self.scheduler.renew(sess.name, used,
                                              trace_id=sess.trace_id)
             else:
@@ -666,10 +687,12 @@ class ChipProxy:
             exec_begin = getattr(self.scheduler, "execute_begin", None)
             if exec_begin is not None:
                 exec_begin()
+            self.slicer.execute_begin(sess.name)
             try:
                 result = fn()
             finally:
                 end = _now_ms()
+                self.slicer.execute_end(sess.name)
                 exec_end = getattr(self.scheduler, "execute_end", None)
                 if exec_end is not None:
                     exec_end()
@@ -1573,6 +1596,7 @@ class ChipProxy:
         consts = args[ncarry:]
         carry = list(args[:ncarry])
         donate = [int(h) for h in req.get("donate", [])]
+        yields_before = sess.preempt_yields
         steps = 0
         bursts = 0
         last_burst = 0
@@ -1643,8 +1667,14 @@ class ChipProxy:
         # repeat = total steps run; burst = the per-burst clamp the
         # token-gated cost model converged on (the quantity
         # steady_state_burst reports)
-        return {"ok": True, "handles": handles, "repeat": steps,
-                "burst": last_burst}
+        rep = {"ok": True, "handles": handles, "repeat": steps,
+               "burst": last_burst}
+        sliced = sess.preempt_yields - yields_before
+        if sliced > 0 and "preempt" in sess.features:
+            # negotiated-only key: an un-negotiated peer's reply frame
+            # stays byte-for-byte even when its hold was sliced
+            rep["sliced"] = sliced
+        return rep
 
     def _chain_abort(self, sess: _Session, exe: _Executable,
                      donate: list[int], bursts: int) -> None:

@@ -143,7 +143,8 @@ class PyTokenCore:
                 next_wake = min(next_wake, c.eligible_at(
                     now_ms, self.window_ms, cap - self.min_quota_ms))
                 continue
-            if best is None or c.vtime < best.vtime:
+            if (best is None or c.vtime < best.vtime
+                    or (c.vtime == best.vtime and c.name < best.name)):
                 best, best_remaining = c, remaining
         if best is None:
             return next_wake
@@ -339,7 +340,7 @@ class TokenScheduler:
                  base_quota_ms: float = BASE_QUOTA_MS,
                  min_quota_ms: float = MIN_QUOTA_MS, native: bool | None = None,
                  clock=None, chip: str = "", ledger=None, blame=None,
-                 ledger_clock=None):
+                 ledger_clock=None, preempt=None):
         self._core = make_core(window_ms, base_quota_ms, min_quota_ms, native)
         self._cond = threading.Condition()
         self._grants: dict[str, float] = {}  # name -> granted quota_ms
@@ -374,6 +375,18 @@ class TokenScheduler:
         #: token cycle. Exceptions are swallowed: quota policy must
         #: never break the data path.
         self.on_demand = None
+        #: preemption plane (kubeshare_tpu.preempt, ROADMAP item 1).
+        #: ``preempt`` is a PreemptionPolicy or None; with None AND an
+        #: empty boost queue the grant path is exactly the core's poll
+        #: — bit-identical to the pre-preemption scheduler.
+        self.preempt = preempt
+        self._preempt_flags: set[str] = set()     # holders marked
+        self._preempt_marked_at: dict[str, float] = {}
+        #: directed-grant queue: (name, kind) granted next regardless
+        #: of FIFO/stride order — the beneficiary, then the preempted
+        #: holder's anti-starvation credit
+        self._boost: deque = deque()
+        self._hold_quota: dict[str, float] = {}   # name -> granted quota
 
     @property
     def core(self):
@@ -399,6 +412,9 @@ class TokenScheduler:
             self._shares.pop(name, None)
             self._effective.pop(name, None)
             self._classes.pop(name, None)
+            self._preempt_flags.discard(name)
+            self._preempt_marked_at.pop(name, None)
+            self._hold_quota.pop(name, None)
             self._cond.notify_all()
 
     def set_effective(self, name: str, request: float, limit: float) -> bool:
@@ -451,6 +467,7 @@ class TokenScheduler:
                 "share_sum": sum(c["effective_request"]
                                  for c in clients.values()),
                 "waiting": [n for n, q in self._waiting.items() if q],
+                "preempted": sorted(self._preempt_flags),
             }
 
     def now_ms(self) -> float:
@@ -498,7 +515,7 @@ class TokenScheduler:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             self._core.release_token(name, used_ms, self._clock())
-            self._note_release(name)
+            self._note_release(name, used_ms)
             self._core.request_token(name)
             self._note_demand(name)
             self._cond.notify_all()
@@ -518,10 +535,139 @@ class TokenScheduler:
         # release can grant the stream again — the core granted once and
         # cleared it.
         quota = self._grants.pop(name)
+        self._hold_quota[name] = quota
         if len(q) > 1:
             self._core.request_token(name)
             self._cond.notify_all()
         return quota
+
+    def _poll_grant(self):
+        """Core poll with directed grants (caller holds ``self._cond``).
+
+        With an empty boost queue this IS ``core.poll`` — the
+        preemption-off grant path is bit-identical to the plain
+        scheduler. With a boost armed and the chip free, every other
+        waiter's request is withdrawn for one poll so the core must
+        pick the boost target, then re-armed — cancel/request are
+        idempotent flag flips in both cores, so stride state (vtime,
+        usage windows) is untouched and shares stay intact. A target
+        that is window-capped drops its boost and the poll is redone
+        in normal order: a directed grant may jump the queue but can
+        never idle the chip (no livelock)."""
+        now = self._clock()
+        if not self._boost:
+            return self._core.poll(now)
+        if self._core.holder() is not None:
+            # chip still held (the preempted holder is draining to its
+            # program boundary) — keep the boost armed
+            return self._core.poll(now)
+        # prune targets that vanished or already hold the token
+        while self._boost:
+            target, _kind = self._boost[0]
+            if target not in self._shares or target in self._held_since:
+                self._boost.popleft()
+                continue
+            break
+        if not self._boost:
+            return self._core.poll(now)
+        target, kind = self._boost[0]
+        if not self._waiting.get(target):
+            # the target isn't asking right now (e.g. the preempted
+            # holder hasn't re-requested yet) — grant in normal order,
+            # keep the boost for when it arrives
+            return self._core.poll(now)
+        others = [n for n, q in self._waiting.items() if q and n != target]
+        for other in others:
+            self._core.cancel_request(other)
+        try:
+            result = self._core.poll(now)
+        finally:
+            for other in others:
+                try:
+                    self._core.request_token(other)
+                except KeyError:
+                    pass
+        if isinstance(result, tuple) and result[0] == target:
+            self._boost.popleft()
+            if self.preempt is not None:
+                self.preempt.note_boost_grant(self.chip,
+                                              credit=kind == "credit")
+            return result
+        if not isinstance(result, tuple):
+            # target is window-capped: forfeit the boost, normal order
+            self._boost.popleft()
+            return self._core.poll(now)
+        return result
+
+    def _maybe_preempt(self, name: str, waited_s: float):
+        """Evaluate the preemption policy for waiter *name* (caller
+        holds ``self._cond``). Fires at most once per hold: the holder
+        is marked (ledger tags its idle-tail from this instant), the
+        waiter and then the holder are queued for directed grants —
+        the holder entry IS the anti-starvation credit, so a preempted
+        best-effort tenant regains the chip after exactly one
+        higher-class grant. Returns seconds until the decision could
+        flip (the waiter's next wake-up), or None."""
+        policy = self.preempt
+        if policy is None or not policy.enabled:
+            return None
+        holder = next(iter(self._held_since), None)
+        if holder is None or holder == name or holder in self._preempt_flags:
+            return None
+        waiter_class = self._classes.get(name, "best-effort")
+        holder_class = self._classes.get(holder, "best-effort")
+        held_s = time.monotonic() - self._held_since[holder]
+        if policy.should_preempt(waiter_class, holder_class,
+                                 waited_s * 1000.0, held_s * 1000.0):
+            self._preempt_flags.add(holder)
+            self._preempt_marked_at[holder] = time.monotonic()
+            self._boost.append((name, "beneficiary"))
+            self._boost.append((holder, "credit"))
+            if self._ledger is not None:
+                self._ledger.mark_preempted(self.chip,
+                                            now=self._ledger_clock())
+            policy.note_preemption(self.chip, holder, waiter_class,
+                                   holder_class)
+            log.debug("%s: preempted holder %s for %s (%s > %s)",
+                      self.chip, holder, name, waiter_class, holder_class)
+            return None
+        if not policy.should_preempt(waiter_class, holder_class,
+                                     _INF, _INF):
+            return None      # class order can never flip the decision
+        due = max(policy.grace_ms / 1000.0 - waited_s,
+                  policy.min_hold_ms / 1000.0 - held_s)
+        return max(0.001, due)
+
+    def preempted(self, name: str) -> bool:
+        """Is *name*'s current hold marked preempted? The proxy's
+        program-boundary check (preempt/slicer.py): a True answer asks
+        the holder to yield — release or renew — at the next execute
+        boundary, forfeiting its remaining quantum."""
+        with self._cond:
+            return name in self._preempt_flags
+
+    def mark_preempted(self, name: str) -> None:
+        """Externally mark holder *name* preempted — the gang
+        coordinator's entry point for gang-atomic preemption (it makes
+        the policy decision itself, across all member chips, in the
+        same sorted-chip total order as every other gang op)."""
+        with self._cond:
+            if name not in self._held_since or name in self._preempt_flags:
+                return
+            self._preempt_flags.add(name)
+            self._preempt_marked_at[name] = time.monotonic()
+            if self._ledger is not None:
+                self._ledger.mark_preempted(self.chip,
+                                            now=self._ledger_clock())
+            self._cond.notify_all()
+
+    def add_boost(self, name: str, credit: bool = False) -> None:
+        """Queue *name* for a directed grant (next grant regardless of
+        FIFO/stride order) — the gang coordinator's beneficiary and
+        anti-starvation hooks."""
+        with self._cond:
+            self._boost.append((name, "credit" if credit else "beneficiary"))
+            self._cond.notify_all()
 
     def _wait_for_grant(self, name: str, deadline: float | None) -> float:
         # Caller holds self._cond and has already requested the token.
@@ -532,9 +678,12 @@ class TokenScheduler:
         ticket = object()
         q = self._waiting.setdefault(name, deque())
         q.append(ticket)
+        wait_t0 = time.monotonic()
         try:
             while True:
-                result = self._core.poll(self._clock())
+                due = self._maybe_preempt(
+                    name, time.monotonic() - wait_t0)
+                result = self._poll_grant()
                 if isinstance(result, tuple):
                     granted, quota = result
                     self._grants[granted] = quota
@@ -554,6 +703,9 @@ class TokenScheduler:
                     wait = None
                 else:
                     wait = max(0.001, (result - self._clock()) / 1000.0)
+                if due is not None:
+                    # wake when the preemption decision could flip
+                    wait = due if wait is None else min(wait, due)
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -618,12 +770,23 @@ class TokenScheduler:
                 self._classes.get(name, "best-effort"), wait_s,
                 now=self._ledger_clock(), trace_id=trace_id, granted=False)
 
-    def _note_release(self, name: str) -> None:
+    def _note_release(self, name: str, used_ms: float = 0.0) -> None:
         # caller holds self._cond, AFTER release_token so the utilization
         # gauge includes the usage interval just reported
         since = self._held_since.pop(name, None)
         if since is not None:
             _HOLD.observe(self.chip, value=time.monotonic() - since)
+        quota = self._hold_quota.pop(name, 0.0)
+        marked = self._preempt_marked_at.pop(name, None)
+        if name in self._preempt_flags:
+            # the preempted holder yielded: meter mark-to-yield latency
+            # and the forfeited quantum it reclaimed for the beneficiary
+            self._preempt_flags.discard(name)
+            if self.preempt is not None:
+                yield_s = (0.0 if marked is None
+                           else time.monotonic() - marked)
+                self.preempt.note_yield(self.chip, yield_s,
+                                        max(0.0, quota - used_ms))
         if self._ledger is not None:
             self._ledger.release(self.chip, now=self._ledger_clock())
         # black-box cadence (rate-limited inside): what this token was
@@ -641,7 +804,7 @@ class TokenScheduler:
     def release(self, name: str, used_ms: float) -> None:
         with self._cond:
             self._core.release_token(name, used_ms, self._clock())
-            self._note_release(name)
+            self._note_release(name, used_ms)
             self._cond.notify_all()
 
     def execute_begin(self) -> None:
@@ -691,6 +854,13 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0,
     coordinator those names answer the standard unknown-op error —
     byte-for-byte the pre-extension wire — so an un-negotiated peer
     observes no difference.
+
+    A scheduler with an attached :class:`~kubeshare_tpu.preempt.policy.
+    PreemptionPolicy` likewise speaks the preemption extension
+    (doc/isolation-wire.md): ``preempt_poll`` (is the connection-bound
+    client's hold marked preempted? — the remote program-boundary
+    check) and ``preempt_state`` (the policy snapshot). Without a
+    policy those names answer the standard unknown-op error too.
     """
     def handle(req: dict, state: dict) -> dict:
         op = req.get("op")
@@ -698,6 +868,15 @@ def serve(scheduler: TokenScheduler, host: str = "127.0.0.1", port: int = 0,
                 "gang_register", "gang_acquire", "gang_release",
                 "gang_state"):
             return _handle_gang(coordinator, op, req, state)
+        if scheduler.preempt is not None and op in ("preempt_poll",
+                                                    "preempt_state"):
+            if op == "preempt_state":
+                return {"ok": True, "state": scheduler.preempt.snapshot()}
+            name = state.get("name")
+            if not name:
+                raise PermissionError(
+                    "connection not bound (register/attach first)")
+            return {"ok": True, "preempted": scheduler.preempted(name)}
         if op not in ("register", "attach", "acquire", "renew", "release",
                       "usage", "unregister"):
             return {"ok": False, "error": f"unknown op {op!r}"}

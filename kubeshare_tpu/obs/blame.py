@@ -12,6 +12,13 @@ chip. Paused windows (migration flips) are attributed to the
 ``(migration)`` pseudo-tenant so operators see flips, not phantom
 co-tenants.
 
+Edges carry a *kind*: ``hold`` (ordinary occupancy), ``migration``
+(the pseudo-tenant), or ``preempted`` — the blamed tenant's occupancy
+overlapped ledger intervals tagged preempted, i.e. the flooder had
+already been marked and was draining to a program boundary for you.
+``topcli --why`` renders the distinction ("waited behind flooder" vs
+"flooder was preempted for you").
+
 The aggregate rides the standard metric family
 ``kubeshare_blame_wait_seconds_total`` so every process's remote-write
 push lands it in the fleet TSDB (PR 8) — the ``topcli --fleet``
@@ -67,6 +74,7 @@ class BlameGraph:
             return []
         rows = self.ledger.account(chip, now - wait_s, now, now=now)
         blamed_secs: dict[str, float] = {}
+        preempted_secs: dict[str, float] = {}
         gangs: dict[str, str] = {}
         for row in rows:
             if row["state"] in OCCUPIED_STATES:
@@ -79,6 +87,9 @@ class BlameGraph:
                 continue
             blamed_secs[tenant] = (blamed_secs.get(tenant, 0.0)
                                    + row["overlap_s"])
+            if row.get("preempted"):
+                preempted_secs[tenant] = (preempted_secs.get(tenant, 0.0)
+                                          + row["overlap_s"])
             if row.get("gang"):
                 gangs[tenant] = row["gang"]
         with self._lock:
@@ -95,10 +106,11 @@ class BlameGraph:
                 self._attributed_s += secs
                 edge = self._edges.setdefault(
                     (victim, blamed, chip),
-                    {"wait_s": 0.0, "count": 0,
+                    {"wait_s": 0.0, "preempted_s": 0.0, "count": 0,
                      "exemplars": deque(maxlen=_MAX_EXEMPLARS),
                      "gangs": set()})
                 edge["wait_s"] += secs
+                edge["preempted_s"] += preempted_secs.get(blamed, 0.0)
                 edge["count"] += 1
                 if trace_id:
                     edge["exemplars"].append(trace_id)
@@ -121,11 +133,17 @@ class BlameGraph:
     # -- queries ------------------------------------------------------
 
     def edges(self) -> list[dict]:
-        """All blame edges, heaviest first."""
+        """All blame edges, heaviest first. ``kind`` distinguishes
+        ordinary holds from migration pauses and preempted drains."""
         with self._lock:
             out = [{
                 "victim": victim, "blamed": blamed, "chip": chip,
                 "wait_s": round(rec["wait_s"], 6),
+                "preempted_s": round(rec.get("preempted_s", 0.0), 6),
+                "kind": ("migration" if blamed == MIGRATION
+                         else "preempted"
+                         if rec.get("preempted_s", 0.0) > 0.0
+                         else "hold"),
                 "count": rec["count"],
                 "gangs": sorted(rec["gangs"]),
                 "trace_ids": list(rec["exemplars"]),
@@ -142,9 +160,11 @@ class BlameGraph:
             if victim is not None and e["victim"] != victim:
                 continue
             rec = agg.setdefault(e["blamed"], {
-                "blamed": e["blamed"], "wait_s": 0.0, "count": 0,
+                "blamed": e["blamed"], "wait_s": 0.0,
+                "preempted_s": 0.0, "count": 0,
                 "chips": set(), "gangs": set(), "trace_ids": []})
             rec["wait_s"] += e["wait_s"]
+            rec["preempted_s"] += e["preempted_s"]
             rec["count"] += e["count"]
             rec["chips"].add(e["chip"])
             rec["gangs"].update(e["gangs"])
@@ -155,6 +175,7 @@ class BlameGraph:
             out.append({
                 "blamed": rec["blamed"],
                 "wait_s": round(rec["wait_s"], 6),
+                "preempted_s": round(rec["preempted_s"], 6),
                 "share": round(rec["wait_s"] / total, 4),
                 "count": rec["count"],
                 "chips": sorted(rec["chips"]),

@@ -16,6 +16,14 @@ into intervals, each in exactly one state:
   while no holder occupies the chip.
 - ``free`` — nobody holds the token and nothing blocks it.
 
+Orthogonally to the state, intervals carry a ``preempted`` tag: when
+the preemption plane marks a holder (``mark_preempted``), the open
+interval closes at the mark and everything the holder burns *after* the
+mark — exactly its preempted idle-tail — is tagged. The state itself
+stays honest (``granted-idle``/``granted-active``); the tag is what
+lets the blame graph distinguish "waited behind a hold" from "the
+holder was preempted for you" (the ``preempted`` edge kind).
+
 Transitions close the open interval at an explicit ``now`` and open the
 next one, so the timeline has no gaps or overlaps *by construction* —
 the chaos invariant (``chaos/invariants.check_ledger_conservation``)
@@ -47,7 +55,7 @@ _SNAPSHOT_RECENT = 32          # intervals shown in the operator view
 class _ChipTimeline:
     """One chip's flag state + closed-interval history."""
 
-    __slots__ = ("origin", "holder", "active", "paused",
+    __slots__ = ("origin", "holder", "active", "paused", "preempted",
                  "open_since", "open_key", "intervals", "totals",
                  "transitions")
 
@@ -56,16 +64,17 @@ class _ChipTimeline:
         self.holder = None           # (tenant, tpu_class, gang, reserving)
         self.active = 0              # in-flight executes under the hold
         self.paused = False
+        self.preempted = False       # holder marked by the preempt plane
         self.open_since = now
-        self.open_key = ("", "", "free", "")
+        self.open_key = ("", "", "free", "", False)
         self.intervals: deque = deque(maxlen=_MAX_INTERVALS)
         self.totals = {s: 0.0 for s in STATES}   # closed intervals only
         self.transitions = 0
 
     def resolve(self) -> tuple:
-        """Current ``(tenant, tpu_class, state, gang)`` from the flags.
-        A holder beats paused beats free — pause blocks *new* grants, so
-        it only shows while the chip is unoccupied."""
+        """Current ``(tenant, tpu_class, state, gang, preempted)`` from
+        the flags. A holder beats paused beats free — pause blocks
+        *new* grants, so it only shows while the chip is unoccupied."""
         if self.holder is not None:
             tenant, tpu_class, gang, reserving = self.holder
             if reserving:
@@ -74,10 +83,10 @@ class _ChipTimeline:
                 state = "granted-active"
             else:
                 state = "granted-idle"
-            return (tenant, tpu_class, state, gang)
+            return (tenant, tpu_class, state, gang, self.preempted)
         if self.paused:
-            return ("", "", "paused", "")
-        return ("", "", "free", "")
+            return ("", "", "paused", "", False)
+        return ("", "", "free", "", False)
 
 
 class ChipTimeLedger:
@@ -128,6 +137,7 @@ class ChipTimeLedger:
         with self._lock:
             tl = self._chip(chip, now)
             tl.holder = (tenant, tpu_class, gang, False)
+            tl.preempted = False
             self._transition(tl, now)
 
     def release(self, chip: str, now=None) -> None:
@@ -137,6 +147,20 @@ class ChipTimeLedger:
             tl = self._chip(chip, now)
             tl.holder = None
             tl.active = 0
+            tl.preempted = False
+            self._transition(tl, now)
+
+    def mark_preempted(self, chip: str, now=None) -> None:
+        """The preemption plane marked the current holder: close the
+        pre-mark portion of the hold and tag everything after — the
+        holder's preempted idle-tail — until grant/release clears it.
+        No-op when nobody holds the chip."""
+        now = self._now(now)
+        with self._lock:
+            tl = self._chip(chip, now)
+            if tl.holder is None:
+                return
+            tl.preempted = True
             self._transition(tl, now)
 
     def execute_begin(self, chip: str, now=None) -> None:
@@ -161,6 +185,7 @@ class ChipTimeLedger:
         with self._lock:
             tl = self._chip(chip, now)
             tl.holder = (tenant, tpu_class, gang, True)
+            tl.preempted = False
             self._transition(tl, now)
 
     def commit(self, chip: str, now=None) -> None:
@@ -209,13 +234,13 @@ class ChipTimeLedger:
             rows = list(tl.intervals)
             rows.append((tl.open_since, max(now, tl.open_since))
                         + tl.open_key)
-        for (s, e, tenant, tpu_class, state, gang) in rows:
+        for (s, e, tenant, tpu_class, state, gang, preempted) in rows:
             overlap = min(e, end) - max(s, start)
             if overlap <= 0.0:
                 continue
             out.append({"overlap_s": overlap, "tenant": tenant,
                         "tpu_class": tpu_class, "state": state,
-                        "gang": gang})
+                        "gang": gang, "preempted": preempted})
         return out
 
     def conservation(self, now=None) -> dict:
@@ -278,13 +303,14 @@ class ChipTimeLedger:
         cons = self.conservation(now)
         with self._lock:
             for chip, tl in items:
-                tenant, tpu_class, state, gang = tl.open_key
+                tenant, tpu_class, state, gang, preempted = tl.open_key
                 rep = cons[chip]
                 chips[chip] = {
                     "state": state,
                     "tenant": tenant,
                     "tpu_class": tpu_class,
                     "gang": gang,
+                    "preempted": preempted,
                     "since_s": round(max(0.0, now - tl.open_since), 6),
                     "elapsed_s": round(rep["elapsed_s"], 6),
                     "by_state": {s: round(v, 6)
@@ -293,8 +319,8 @@ class ChipTimeLedger:
                     "recent": [
                         {"start": round(s, 6), "end": round(e, 6),
                          "tenant": t, "tpu_class": c, "state": st,
-                         "gang": g}
-                        for (s, e, t, c, st, g)
+                         "gang": g, "preempted": p}
+                        for (s, e, t, c, st, g, p)
                         in list(tl.intervals)[-_SNAPSHOT_RECENT:]],
                 }
         return {"chips": chips, "states": list(STATES)}

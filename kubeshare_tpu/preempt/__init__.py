@@ -1,0 +1,24 @@
+"""Preemption plane: enforced SLO classes via gang-aware preemptive
+token scheduling (ROADMAP item 1, closed by this package).
+
+- :mod:`kubeshare_tpu.preempt.policy` — the :class:`PreemptionPolicy`
+  the :class:`~kubeshare_tpu.isolation.tokensched.TokenScheduler`
+  consults under its own lock: a latency-class request waiting behind a
+  best-effort holder past ``grace_ms`` marks the holder preempted,
+  forfeits its remaining quantum, and grants the latency request next
+  regardless of FIFO order; an anti-starvation credit re-grants the
+  preempted tenant right after the beneficiary, bounding its delay.
+- :mod:`kubeshare_tpu.preempt.slicer` — program-boundary slicing
+  bookkeeping for the isolation proxy: long multi-step holds yield the
+  token *between* executes, never mid-program.
+
+Gang-aware preemption lives in
+:mod:`kubeshare_tpu.gang.coordinator` (a latency gang preempts a
+best-effort gang atomically across member chips in the same
+sorted-chip total order as every other gang operation).
+"""
+
+from .policy import CLASS_PRIORITY, PreemptionPolicy
+from .slicer import BoundarySlicer
+
+__all__ = ["CLASS_PRIORITY", "PreemptionPolicy", "BoundarySlicer"]

@@ -37,6 +37,9 @@ API (JSON):
 - ``GET  /ledger``    chip-time ledger + blame graph: per-chip interval
   accounting and per-(victim, blamed, chip) wait attribution
   (doc/observability.md, contention attribution)
+- ``GET  /preempt``   preemption plane: policy config + enforcement stats
+  (preemptions fired, quantum reclaimed, gang preemptions; ``attached:
+  false`` until a policy is wired — doc/isolation-wire.md)
 - ``GET  /healthz``
 
 Overload shedding: with ``max_pending`` set, ``POST /schedule`` answers
@@ -103,6 +106,9 @@ class SchedulerService:
         from ..gang import GangTokenCoordinator
         self.gangcoord = GangTokenCoordinator(ledger=self.ledger)
         self.dispatcher.attach_gang_coordinator(self.gangcoord)
+        # preemption plane (kubeshare_tpu.preempt, ROADMAP item 1):
+        # None until attach_preempt — GET /preempt reports detached
+        self.preempt = None
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
@@ -138,6 +144,15 @@ class SchedulerService:
         """Wire a serving :class:`~..serving.FrontDoor` (doc/serving.md);
         exposes its join view on ``/serving``."""
         self.serving = frontdoor
+        return self
+
+    def attach_preempt(self, policy) -> "SchedulerService":
+        """Wire a :class:`~..preempt.PreemptionPolicy`: the gang
+        coordinator starts preempting lower-class gangs, and
+        ``GET /preempt`` exposes the policy config + enforcement
+        stats."""
+        self.preempt = policy
+        self.gangcoord.preempt = policy
         return self
 
     # -- operations --------------------------------------------------------
@@ -240,6 +255,16 @@ class SchedulerService:
         snap = self.ledger.snapshot()
         snap["attached"] = True
         snap["blame"] = self.blame.state()
+        return snap
+
+    def preempt_state(self) -> dict:
+        """``GET /preempt`` body: policy config + enforcement stats
+        (preemptions fired, quantum reclaimed, gang preemptions), or
+        ``attached: false`` when no policy is wired."""
+        if self.preempt is None:
+            return {"attached": False}
+        snap = self.preempt.snapshot()
+        snap["attached"] = True
         return snap
 
     def flightrecorder_state(self) -> dict:
@@ -356,6 +381,8 @@ class SchedulerService:
                     return self._reply(200, svc.gangs_state())
                 if self.path == "/ledger":
                     return self._reply(200, svc.ledger_state())
+                if self.path == "/preempt":
+                    return self._reply(200, svc.preempt_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -486,6 +513,15 @@ def main(argv=None) -> None:
                              "registry fleet TSDB")
     parser.add_argument("--push-period", type=float, default=5.0,
                         help="remote-write push period in seconds")
+    parser.add_argument("--preempt", action="store_true",
+                        help="attach the preemption plane: latency-class "
+                             "requests preempt best-effort holders past "
+                             "grace (gang-atomic for gangs); /preempt "
+                             "exposes config + enforcement stats")
+    parser.add_argument("--preempt-grace-ms", type=float, default=None,
+                        help="how long a latency-class request waits "
+                             "behind a lower-class holder before it is "
+                             "preempted (default: policy default)")
     args = parser.parse_args(argv)
 
     if args.flight_dump_dir:
@@ -512,6 +548,12 @@ def main(argv=None) -> None:
                                   journal_path=(args.autopilot_journal
                                                 or None),
                                   gang_coordinator=svc.gangcoord)))
+    if args.preempt:
+        from ..preempt import PreemptionPolicy
+
+        kwargs = ({} if args.preempt_grace_ms is None
+                  else {"grace_ms": args.preempt_grace_ms})
+        svc.attach_preempt(PreemptionPolicy(**kwargs))
     svc.serve(args.host, args.port)
     if not args.no_remote_write:
         svc.start_remote_write(period_s=args.push_period)

@@ -175,7 +175,9 @@ def simulate_critpath(n_requests: int, seed: int = 0,
 
 
 def simulate_contention(n_requests: int, seed: int = 0,
-                        qps: float = 25.0) -> dict:
+                        qps: float = 25.0, preempt: bool = False,
+                        grace_s: float = 0.005,
+                        slice_step_s: float = 0.01) -> dict:
     """Deterministic virtual-time contention replay for the chip-time
     ledger + blame graph (doc/observability.md).
 
@@ -194,6 +196,17 @@ def simulate_contention(n_requests: int, seed: int = 0,
     Everything derives from ``seed`` in virtual time: two runs produce
     byte-identical JSON — the determinism the CI replay gate and
     ``sim --contention`` lean on.
+
+    With ``preempt=True`` the flood's holds are sliced into
+    ``slice_step_s`` program steps (the virtual analogue of the proxy's
+    program-boundary slicer, doc/isolation-wire.md).  When the next
+    latency arrival has waited past ``grace_s`` the holder is marked
+    preempted mid-step — the ledger tags the drain from the mark to the
+    step boundary — and the flood yields at that boundary, forfeiting
+    the remainder of the hold.  Yields never happen mid-step.  The
+    output gains a ``preempt`` sub-dict; with ``preempt=False`` the
+    replay and its JSON are byte-identical to the non-preemptive
+    baseline (same rng draw order, same keys).
     """
     from ..obs.blame import BlameGraph
     from ..obs.ledger import ChipTimeLedger
@@ -213,6 +226,8 @@ def simulate_contention(n_requests: int, seed: int = 0,
 
     lat_waits: list[float] = []
     flood_holds = 0
+    preemptions = 0
+    reclaimed_s = 0.0
     t = 0.0                      # time the chip token is next free
     flood_ready_at = 0.0         # when flood's standing request arrived
     i = 0                        # next unserved latency arrival
@@ -237,6 +252,42 @@ def simulate_contention(n_requests: int, seed: int = 0,
         ledger.release(chip, now=t)
         return wait_s
 
+    def serve_flood_sliced(grant_t, requested_t, hold_s, trace_id):
+        """Preemptive flood hold: execute in program steps, mark the
+        holder preempted the instant the next latency arrival crosses
+        its grace window, and yield at the following step boundary —
+        never mid-step — forfeiting the rest of the hold."""
+        nonlocal t, preemptions, reclaimed_s
+        vclock[0] = grant_t
+        wait_s = grant_t - requested_t
+        if wait_s > 0.0:
+            blame.account_wait(chip, "tenant-flood", "best-effort",
+                               wait_s, now=grant_t, trace_id=trace_id)
+        ledger.grant(chip, "tenant-flood", "best-effort", now=grant_t)
+        done = 0.0
+        yielded = False
+        while done < hold_s:
+            s0 = grant_t + done
+            cur = min(slice_step_s, hold_s - done)
+            fire_t = (arrivals[i][0] + grace_s if i < len(arrivals)
+                      else math.inf)
+            ledger.execute_begin(chip, now=s0)
+            if fire_t <= s0 + cur:
+                # the waiter crossed its grace window during this step:
+                # the tag covers the drain from the mark to the boundary
+                ledger.mark_preempted(chip, now=max(s0, fire_t))
+                yielded = True
+            ledger.execute_end(chip, now=s0 + cur)
+            done += cur
+            if yielded:
+                break
+        t = grant_t + done
+        vclock[0] = t
+        ledger.release(chip, now=t)
+        if yielded:
+            preemptions += 1
+            reclaimed_s += hold_s - done
+
     while i < len(arrivals):
         next_lat = arrivals[i][0]
         if next_lat <= t:
@@ -250,9 +301,14 @@ def simulate_contention(n_requests: int, seed: int = 0,
             # flood is waiting (or ready right now): it takes the token
             grant_t = t
             hold = rng.uniform(0.04, 0.22)
-            serve("tenant-flood", "best-effort", grant_t, flood_ready_at,
-                  hold, f"sim-flood-{seed}-{flood_holds:04d}",
-                  exec_frac=0.8)
+            if preempt:
+                serve_flood_sliced(grant_t, flood_ready_at, hold,
+                                   f"sim-flood-{seed}-{flood_holds:04d}")
+            else:
+                serve("tenant-flood", "best-effort", grant_t,
+                      flood_ready_at, hold,
+                      f"sim-flood-{seed}-{flood_holds:04d}",
+                      exec_frac=0.8)
             flood_holds += 1
             flood_ready_at = t + rng.uniform(0.0, 0.01)  # think gap
         else:
@@ -269,7 +325,7 @@ def simulate_contention(n_requests: int, seed: int = 0,
         return waits[min(len(waits) - 1,
                          max(0, math.ceil(q * len(waits)) - 1))]
 
-    return {
+    out = {
         "requests": n_requests,
         "seed": seed,
         "virtual_elapsed_s": round(t, 6),
@@ -288,6 +344,17 @@ def simulate_contention(n_requests: int, seed: int = 0,
         "top_blamed": blame.top_blamed("tenant-lat"),
         "blame": blame.state(),
     }
+    if preempt:
+        # added only when enabled so the preempt=False JSON stays
+        # byte-identical to the non-preemptive baseline
+        out["preempt"] = {
+            "enabled": True,
+            "grace_s": grace_s,
+            "slice_step_s": slice_step_s,
+            "preemptions": preemptions,
+            "reclaimed_s": round(reclaimed_s, 6),
+        }
+    return out
 
 
 @dataclass
@@ -740,7 +807,8 @@ def main(argv=None) -> None:
                      "/ --serve / --critpath / --chaos / --contention "
                      "is required")
     if args.contention:
-        out = simulate_contention(args.contention, seed=args.seed)
+        out = simulate_contention(args.contention, seed=args.seed,
+                                  preempt=args.preempt)
         print(json.dumps({"contention": out}, sort_keys=True))
         return
     if args.chaos:

@@ -523,9 +523,10 @@ def why_snapshot(client: RegistryClient, scheduler, target: str) -> dict:
         if e.get("victim") != tenant:
             continue
         rec = agg.setdefault(e["blamed"], {
-            "blamed": e["blamed"], "wait_s": 0.0, "count": 0,
-            "chips": set(), "gangs": set(), "trace_ids": []})
+            "blamed": e["blamed"], "wait_s": 0.0, "preempted_s": 0.0,
+            "count": 0, "chips": set(), "gangs": set(), "trace_ids": []})
         rec["wait_s"] += e.get("wait_s", 0.0)
+        rec["preempted_s"] += e.get("preempted_s", 0.0)
         rec["count"] += e.get("count", 0)
         rec["chips"].add(e.get("chip", ""))
         rec["gangs"].update(e.get("gangs", []))
@@ -533,6 +534,7 @@ def why_snapshot(client: RegistryClient, scheduler, target: str) -> dict:
     total = sum(r["wait_s"] for r in agg.values()) or 1.0
     out["ranked"] = [
         {"blamed": r["blamed"], "wait_s": round(r["wait_s"], 6),
+         "preempted_s": round(r["preempted_s"], 6),
          "share": round(r["wait_s"] / total, 4), "count": r["count"],
          "chips": sorted(r["chips"]), "gangs": sorted(r["gangs"]),
          "trace_ids": r["trace_ids"][-4:]}
@@ -611,6 +613,11 @@ def render_why(snap: dict) -> str:
                      "waits):")
         for i, r in enumerate(snap["ranked"], 1):
             tail = ""
+            if r.get("preempted_s"):
+                # the blamed tenant was preempted for this tenant — it
+                # yielded, it did not just sit on the chip
+                tail += (f"  [preempted for you: "
+                         f"{r['preempted_s']:.3f}s]")
             if r.get("gangs"):
                 tail += f"  [gang {', '.join(r['gangs'])}]"
             if r.get("trace_ids"):

@@ -1,0 +1,525 @@
+"""Preemption-plane benchmark: enforced SLO classes under a noisy
+neighbour, single-chip and gang-atomic (doc/isolation-wire.md,
+doc/gang.md, doc/observability.md ``kubeshare_preempt_*``).
+
+Three single-chip runs plus two 4-chip gang runs, one JSON object
+(committed as ``bench_preempt.json``):
+
+- **single.exclusive** — the latency tenant alone on the chip: the
+  reference for grant-to-completion p99 and throughput.
+- **single.preempt_off** — the same latency tenant behind a
+  work-conserving best-effort flooder holding 50 ms bursts, no policy
+  attached: the suffering the preemption plane exists to remove.
+- **single.preempt_on** — same contention with a
+  :class:`~kubeshare_tpu.preempt.PreemptionPolicy` attached and the
+  flooder slicing at program boundaries through a
+  :class:`~kubeshare_tpu.preempt.BoundarySlicer`.
+- **gang.exclusive / gang.preempt_on** — the same pair on a 4-chip
+  latency gang behind a best-effort flooder gang through the
+  :class:`~kubeshare_tpu.gang.coordinator.GangTokenCoordinator`
+  two-phase protocol.
+
+Gates (``--check``): preempt-on grant-to-completion p99 inflated less
+than 10% over exclusive and throughput at least 90% of exclusive, on
+the single chip AND the gang; the latency tenant's blame-graph
+wait-seconds attributed to the flooder collapse at least 5x versus the
+preempt-off contention baseline (``bench_contention.json``,
+duration-normalised); zero mid-execute yields (no program is ever
+interrupted mid-execute — slices land between executes only); every
+gang grant is the full 4-chip set (no partial-preemption window); the
+policy actually fired (preemptions, yields, gang preemptions all
+nonzero); ledger conservation clean.
+
+Run: ``python scripts/bench_preempt.py`` -> JSON on stdout.
+``--baseline FILE`` prints deltas; ``--write FILE`` saves fresh
+numbers; ``--check`` exits non-zero unless every bar holds (``make
+bench-preempt`` does all three).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CHIP = "bench-preempt-chip"
+GANG_CHIPS = tuple(f"bp-gang-{i}" for i in range(4))
+WINDOW_MS = 400.0
+BASE_QUOTA_MS = 60.0
+MIN_QUOTA_MS = 5.0
+PHASE_S = 4.0            # wall seconds per run
+LAT_HOLD_S = 0.050       # latency tenant program length per grant —
+                         # long enough that millisecond-scale host
+                         # scheduler stalls stay inside the 10% p99 bar
+LAT_PERIOD_S = 0.020     # latency tenant think time between requests
+FLOOD_STEP_S = 0.001     # flooder program-step (slice boundary grain)
+FLOOD_STEPS = 50         # un-preempted flood hold = 50 ms
+GRACE_MS = 0.5
+MIN_HOLD_MS = 0.5
+GANG_PERIOD_S = 0.050    # gang latency think time (reserve is pricier)
+GANG_WINDOW_S = 0.004    # anchor-chip reserve window under preemption
+INFLATION_BAR = 0.10     # p99 grant-to-completion roof vs exclusive
+THROUGHPUT_BAR = 0.90    # completions floor vs exclusive
+COLLAPSE_BAR = 5.0       # blame-to-flooder wait-rate collapse floor
+
+_HIGHER_IS_BETTER = (
+    "single.preempt_on.completions", "single.throughput_ratio",
+    "single.blame_collapse_vs_contention", "single.blame_collapse_vs_off",
+    "gang.preempt_on.completions", "gang.throughput_ratio",
+)
+
+
+# --------------------------------------------------------------------------
+# phase 1: single chip — exclusive / preempt-off / preempt-on
+# --------------------------------------------------------------------------
+
+def _pct(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+
+def _spin(seconds: float) -> None:
+    # the latency tenant's "program": compute-bound busy-wait, immune
+    # to sleep oversleep, so the grant-to-completion p99 bars measure
+    # scheduling interference rather than timer slack
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        pass
+
+
+def run_single(mode: str) -> dict:
+    """One real-time run; *mode* is ``exclusive`` (latency tenant has
+    the chip to itself), ``preempt-off`` (flooder on the same chip, no
+    policy) or ``preempt-on``. The flooder thread runs in EVERY mode —
+    in ``exclusive`` it floods a shadow chip — so all three runs carry
+    identical host CPU/GIL load and the deltas isolate chip-level
+    scheduling, not thread-count noise."""
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+    from kubeshare_tpu.obs.blame import BlameGraph
+    from kubeshare_tpu.obs.ledger import ChipTimeLedger
+    from kubeshare_tpu.preempt import BoundarySlicer, PreemptionPolicy
+
+    policy = (PreemptionPolicy(grace_ms=GRACE_MS, min_hold_ms=MIN_HOLD_MS)
+              if mode == "preempt-on" else None)
+    ledger = ChipTimeLedger()
+    blame = BlameGraph(ledger=ledger)
+    sched = TokenScheduler(WINDOW_MS, BASE_QUOTA_MS, MIN_QUOTA_MS,
+                           chip=CHIP, ledger=ledger, blame=blame,
+                           preempt=policy)
+    sched.add_client("lat/pod-0", 0.8, 0.95, tpu_class="latency")
+    shadow = None
+    if mode == "exclusive":
+        shadow = TokenScheduler(WINDOW_MS, BASE_QUOTA_MS, MIN_QUOTA_MS,
+                                chip=CHIP + "-shadow")
+        shadow.add_client("flood/pod-0", 0.15, 0.9,
+                          tpu_class="best-effort")
+        flood_sched = shadow
+    else:
+        sched.add_client("flood/pod-0", 0.15, 0.9, tpu_class="best-effort")
+        flood_sched = sched
+    slicer = BoundarySlicer(scheduler=flood_sched)
+
+    stop = threading.Event()
+    counts = {"flood": 0, "lat": 0}
+    waits: list[float] = []
+    gtc: list[float] = []        # grant-to-completion
+
+    def flooder():
+        # work-conserving 50 ms programs in 1 ms steps; with the policy
+        # attached the slicer yields the hold at the next step boundary
+        # after a preemption mark — never mid-step
+        name = "flood/pod-0"
+        while not stop.is_set():
+            try:
+                flood_sched.acquire(name, timeout=0.5)
+            except TimeoutError:
+                continue
+            used = 0.0
+            try:
+                for _ in range(FLOOD_STEPS):
+                    if stop.is_set():
+                        break
+                    slicer.execute_begin(name)
+                    flood_sched.execute_begin()
+                    time.sleep(FLOOD_STEP_S)
+                    flood_sched.execute_end()
+                    slicer.execute_end(name)
+                    used += FLOOD_STEP_S * 1000.0
+                    if slicer.should_yield(name):
+                        slicer.note_yield(name)
+                        flood_sched.renew(name, used, timeout=0.5)
+                        used = 0.0
+            except TimeoutError:
+                continue             # renew timed out at shutdown
+            flood_sched.release(name, used)
+            counts["flood"] += 1
+
+    def latency():
+        name = "lat/pod-0"
+        i = 0
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                sched.acquire(name, timeout=2.0,
+                              trace_id=f"bench-preempt-{i:05d}")
+            except TimeoutError:
+                continue
+            t1 = time.monotonic()
+            sched.execute_begin()
+            _spin(LAT_HOLD_S)
+            sched.execute_end()
+            t2 = time.monotonic()   # program done; release is bookkeeping
+            sched.release(name, LAT_HOLD_S * 1000.0)
+            waits.append(t1 - t0)
+            gtc.append(t2 - t1)
+            counts["lat"] += 1
+            i += 1
+            time.sleep(LAT_PERIOD_S)
+
+    threads = [threading.Thread(target=latency),
+               threading.Thread(target=flooder)]
+    for t in threads:
+        t.start()
+    time.sleep(PHASE_S)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    violations = ledger.check()
+    sched.close()
+    if shadow is not None:
+        shadow.close()
+
+    flood_blame = next((r for r in blame.top_blamed("lat")
+                        if r["blamed"] == "flood"), None)
+    out = {
+        "phase_s": PHASE_S,
+        "completions": counts["lat"],
+        "flood_holds": counts["flood"],
+        "wait_p99_ms": round(_pct(waits, 0.99) * 1000.0, 3),
+        "gtc_p50_ms": round(_pct(gtc, 0.50) * 1000.0, 3),
+        "gtc_p99_ms": round(_pct(gtc, 0.99) * 1000.0, 3),
+        "blame_to_flood_s": round(flood_blame["wait_s"], 6)
+        if flood_blame else 0.0,
+        "conservation_violations": len(violations),
+        "slicer": slicer.stats(),
+    }
+    if policy is not None:
+        s = policy.snapshot()["stats"]
+        out["preemptions"] = s["preemptions"]
+        out["yields"] = s["yields"]
+        out["reclaimed_ms"] = s["reclaimed_ms"]
+        out["boost_grants"] = s["boost_grants"]
+        out["credits_repaid"] = s["credits_repaid"]
+    return out
+
+
+# --------------------------------------------------------------------------
+# phase 2: 4-chip gang — exclusive / preempt-on
+# --------------------------------------------------------------------------
+
+def run_gang(mode: str) -> dict:
+    """A 4-chip latency gang, alone on its sub-mesh (``exclusive``) or
+    behind a best-effort flooder gang with gang-atomic preemption
+    (``preempt-on``). As in the single-chip phase the flooder gang
+    runs in every mode — in ``exclusive`` it occupies four shadow
+    chips through the same coordinator — so both runs carry identical
+    host load and coordinator lock traffic."""
+    from kubeshare_tpu.gang import GangTokenCoordinator
+    from kubeshare_tpu.isolation.tokensched import TokenScheduler
+    from kubeshare_tpu.preempt import BoundarySlicer, PreemptionPolicy
+
+    policy = (PreemptionPolicy(grace_ms=GRACE_MS, min_hold_ms=MIN_HOLD_MS)
+              if mode == "preempt-on" else None)
+    flood_chips = (GANG_CHIPS if mode != "exclusive"
+                   else tuple(f"{c}-shadow" for c in GANG_CHIPS))
+    scheds = {}
+    for chip in set(GANG_CHIPS) | set(flood_chips):
+        s = TokenScheduler(WINDOW_MS, BASE_QUOTA_MS, MIN_QUOTA_MS,
+                           chip=chip, preempt=policy)
+        if chip in GANG_CHIPS:
+            s.add_client(f"lat-{chip}", 0.8, 0.95, tpu_class="latency")
+        if chip in flood_chips:
+            s.add_client(f"flood-{chip}", 0.15, 0.9,
+                         tpu_class="best-effort")
+        scheds[chip] = s
+    coord = GangTokenCoordinator(reserve_window_s=GANG_WINDOW_S,
+                                 backoff_base_s=0.001,
+                                 backoff_max_s=0.01, preempt=policy)
+    for chip, s in scheds.items():
+        coord.attach_chip(chip, s)
+    coord.register_gang("lat", [(c, f"lat-{c}") for c in GANG_CHIPS],
+                        tpu_class="latency")
+    coord.register_gang("flood",
+                        [(c, f"flood-{c}") for c in flood_chips],
+                        tpu_class="best-effort")
+    slicer = BoundarySlicer(scheduler=coord)
+
+    stop = threading.Event()
+    counts = {"flood": 0, "lat": 0, "partial": 0}
+    waits: list[float] = []
+    gtc: list[float] = []
+
+    def flooder():
+        # the victim runner: holds all four chips in 1 ms program steps
+        # and yields its FULL set at the first boundary after the
+        # coordinator requests gang preemption
+        while not stop.is_set():
+            try:
+                coord.acquire("flood", timeout=0.5)
+            except TimeoutError:
+                continue
+            used = 0.0
+            for _ in range(FLOOD_STEPS):
+                if stop.is_set():
+                    break
+                slicer.execute_begin("flood")
+                time.sleep(FLOOD_STEP_S)
+                slicer.execute_end("flood")
+                used += FLOOD_STEP_S * 1000.0
+                if slicer.should_yield("flood"):
+                    slicer.note_yield("flood")
+                    break
+            coord.release("flood", used)
+            counts["flood"] += 1
+
+    def latency():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                quotas = coord.acquire("lat", timeout=2.0)
+            except TimeoutError:
+                continue
+            t1 = time.monotonic()
+            if set(quotas) != set(GANG_CHIPS):
+                counts["partial"] += 1     # never: gang grants are atomic
+            _spin(LAT_HOLD_S)
+            t2 = time.monotonic()   # program done; release is bookkeeping
+            coord.release("lat", LAT_HOLD_S * 1000.0)
+            waits.append(t1 - t0)
+            gtc.append(t2 - t1)
+            counts["lat"] += 1
+            time.sleep(GANG_PERIOD_S)
+
+    threads = [threading.Thread(target=latency),
+               threading.Thread(target=flooder)]
+    for t in threads:
+        t.start()
+    time.sleep(PHASE_S)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    for s in scheds.values():
+        s.close()
+
+    out = {
+        "phase_s": PHASE_S,
+        "chips": len(GANG_CHIPS),
+        "completions": counts["lat"],
+        "flood_holds": counts["flood"],
+        "partial_grants": counts["partial"],
+        "wait_p99_ms": round(_pct(waits, 0.99) * 1000.0, 3),
+        "gtc_p50_ms": round(_pct(gtc, 0.50) * 1000.0, 3),
+        "gtc_p99_ms": round(_pct(gtc, 0.99) * 1000.0, 3),
+        "slicer": slicer.stats(),
+    }
+    if policy is not None:
+        s = policy.snapshot()["stats"]
+        out["gang_preemptions"] = s["gang_preemptions"]
+        out["preemptions"] = s["preemptions"]
+    return out
+
+
+# --------------------------------------------------------------------------
+# harness
+# --------------------------------------------------------------------------
+
+def _rate(blame_s: float, phase_s: float) -> float:
+    return blame_s / phase_s if phase_s else 0.0
+
+
+def run_bench() -> dict:
+    # the p99 bars compare millisecond-scale programs across threads;
+    # the default 5 ms GIL switch interval alone can stall a program a
+    # full bar-width, so tighten it for the measurement
+    sys.setswitchinterval(0.0005)
+    single = {
+        "exclusive": run_single("exclusive"),
+        "preempt_off": run_single("preempt-off"),
+        "preempt_on": run_single("preempt-on"),
+    }
+    gang = {
+        "exclusive": run_gang("exclusive"),
+        "preempt_on": run_gang("preempt-on"),
+    }
+
+    # blame-to-flooder collapse, duration-normalised: the preempt-off
+    # contention baseline (bench_contention.json) vs this bench's
+    # preempt-on run. Falls back to this bench's own preempt-off run
+    # when the committed baseline is absent.
+    on_rate = _rate(single["preempt_on"]["blame_to_flood_s"], PHASE_S)
+    off_rate = _rate(single["preempt_off"]["blame_to_flood_s"], PHASE_S)
+    contention_rate = off_rate
+    contention_src = "bench_preempt preempt_off run"
+    try:
+        base = json.loads((REPO / "bench_contention.json").read_text())
+        contention_rate = _rate(base["contention"]["blame_attributed_s"],
+                                base["contention"]["phase_s"])
+        contention_src = "bench_contention.json"
+    except (OSError, ValueError, KeyError):
+        pass
+
+    def infl(pair):
+        ref = pair["exclusive"]["gtc_p99_ms"]
+        return round(pair["preempt_on"]["gtc_p99_ms"] / ref - 1.0, 4) \
+            if ref else 0.0
+
+    def thr(pair):
+        ref = pair["exclusive"]["completions"]
+        return round(pair["preempt_on"]["completions"] / ref, 4) \
+            if ref else 0.0
+
+    single["gtc_p99_inflation"] = infl(single)
+    single["throughput_ratio"] = thr(single)
+    single["blame_collapse_vs_contention"] = (
+        round(contention_rate / on_rate, 2) if on_rate else float("inf"))
+    single["blame_collapse_source"] = contention_src
+    single["blame_collapse_vs_off"] = (
+        round(off_rate / on_rate, 2) if on_rate else float("inf"))
+    gang["gtc_p99_inflation"] = infl(gang)
+    gang["throughput_ratio"] = thr(gang)
+    return {"single": single, "gang": gang}
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (doc/isolation-wire.md, doc/gang.md)."""
+    s, g = out["single"], out["gang"]
+    mid = (s["preempt_on"]["slicer"]["mid_execute_yields"]
+           + g["preempt_on"]["slicer"]["mid_execute_yields"])
+    bars = [
+        ("single.gtc_p99_inflation",
+         s["gtc_p99_inflation"] < INFLATION_BAR,
+         "preempt-on grant-to-completion p99 must sit within "
+         f"{INFLATION_BAR:.0%} of the exclusive chip"),
+        ("single.throughput_ratio",
+         s["throughput_ratio"] >= THROUGHPUT_BAR,
+         f"preempt-on latency throughput must stay >= "
+         f"{THROUGHPUT_BAR:.0%} of exclusive"),
+        ("single.blame_collapse_vs_contention",
+         s["blame_collapse_vs_contention"] >= COLLAPSE_BAR,
+         f"wait-seconds blamed on the flooder must collapse >= "
+         f"{COLLAPSE_BAR:.0f}x vs the preempt-off contention baseline"),
+        ("single.preempt_on.preemptions",
+         s["preempt_on"].get("preemptions", 0) >= 1,
+         "the policy must actually fire under the flood"),
+        ("single.preempt_on.yields",
+         s["preempt_on"].get("yields", 0) >= 1,
+         "the flooder must yield at a program boundary"),
+        ("single.preempt_on.conservation_violations",
+         s["preempt_on"]["conservation_violations"] == 0,
+         "the ledger must conserve through preempted tails"),
+        ("mid_execute_yields", mid == 0,
+         "no execute may ever be interrupted mid-program — slices "
+         "land between executes only"),
+        ("gang.gtc_p99_inflation",
+         g["gtc_p99_inflation"] < INFLATION_BAR,
+         f"gang preempt-on grant-to-completion p99 must sit within "
+         f"{INFLATION_BAR:.0%} of the exclusive gang"),
+        ("gang.throughput_ratio",
+         g["throughput_ratio"] >= THROUGHPUT_BAR,
+         f"gang preempt-on throughput must stay >= "
+         f"{THROUGHPUT_BAR:.0%} of exclusive"),
+        ("gang.preempt_on.gang_preemptions",
+         g["preempt_on"].get("gang_preemptions", 0) >= 1,
+         "gang-atomic preemption must actually fire"),
+        ("gang.partial_grants",
+         g["exclusive"]["partial_grants"] == 0
+         and g["preempt_on"]["partial_grants"] == 0,
+         "every gang grant must deliver the full member set — no "
+         "partial-preemption window"),
+    ]
+    failed = [f"{name}: {why} (got {_lookup(out, name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _metric_keys(out: dict) -> list:
+    return ["single.gtc_p99_inflation", "single.throughput_ratio",
+            "single.blame_collapse_vs_contention",
+            "single.blame_collapse_vs_off",
+            "single.preempt_on.completions",
+            "single.preempt_on.wait_p99_ms",
+            "single.preempt_on.preemptions",
+            "gang.gtc_p99_inflation", "gang.throughput_ratio",
+            "gang.preempt_on.completions",
+            "gang.preempt_on.gang_preemptions"]
+
+
+def _lookup(out: dict, key: str):
+    node = out
+    for part in key.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = _lookup(fresh, key), _lookup(base, key)
+        if new is None or old is None:
+            print(f"#   {key:44s} {old!s:>8} -> {new!s:>8}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:44s} {old!s:>8} -> {new!s:>8}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_preempt")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the inflation, throughput, "
+                             "blame-collapse, gang-atomicity and "
+                             "boundary-slicing bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

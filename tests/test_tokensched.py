@@ -360,3 +360,50 @@ def test_tcp_server_error_reply():
                            "request": 2.0, "limit": 1.0})
     finally:
         server.shutdown()
+
+
+def test_cores_agree_on_cancel_and_timeout_trace():
+    """Drive both cores through a deterministic request/cancel/poll
+    trace — grants, wake times, and holder state must match, including
+    cancel of an unknown name (silent no-op), cancel of the current
+    holder (no effect on the hold), and cancel-then-re-request (the
+    façade's acquire-timeout path)."""
+    try:
+        native = NativeTokenCore(WINDOW, BASE, MIN)
+    except RuntimeError:
+        pytest.skip("native core unavailable")
+    py = PyTokenCore(WINDOW, BASE, MIN)
+    for c in (native, py):
+        c.add_client("a", 0.5, 1.0)
+        c.add_client("b", 0.3, 0.6)
+    now = 0.0
+    for i in range(200):
+        step = i % 10
+        for c in (native, py):
+            if step in (0, 4):
+                c.request_token("a")
+            if step in (0, 6):
+                c.request_token("b")
+            if step == 2:
+                c.cancel_request("b")      # withdraw mid-wait
+            if step == 3:
+                c.cancel_request("ghost")  # unknown: silent no-op
+            if step == 5:
+                c.cancel_request(c.holder() or "a")  # holder: no effect
+        gn, gp = native.poll(now), py.poll(now)
+        assert isinstance(gn, tuple) == isinstance(gp, tuple), (i, gn, gp)
+        if isinstance(gn, tuple):
+            assert gn[0] == gp[0], i
+            assert gn[1] == pytest.approx(gp[1], abs=1e-6)
+            burst = min(gn[1], 23.0)
+            now += burst
+            native.release_token(gn[0], burst, now)
+            py.release_token(gp[0], burst, now)
+        else:
+            # identical wake times (both may be inf when nobody waits)
+            assert gn == pytest.approx(gp, abs=1e-3), i
+            now += 7.0
+        assert native.holder() == py.holder(), i
+        for name in ("a", "b"):
+            assert native.window_usage(name, now) == pytest.approx(
+                py.window_usage(name, now), abs=1e-6), (i, name)
