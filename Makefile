@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile image clean obs-check
 
 all: native
 
@@ -146,6 +146,16 @@ bench-contention:
 bench-preempt:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_preempt.py --check \
 		--baseline bench_preempt.json --write bench_preempt.json
+
+# Contention-profiler bench (doc/observability.md, "Locks, phases, and
+# profiles"): profiler overhead on the bench_health admission-check hot
+# loop, dispatcher phase-attribution coverage, and tracked-wait accuracy
+# under sim --churn load vs a direct timing harness; --check gates the
+# <=2% overhead, >=95% coverage, dispatcher-top-contended and <=10%
+# wait-accuracy bars, then refreshes bench_profile.json.
+bench-profile:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_profile.py --check \
+		--baseline bench_profile.json --write bench_profile.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -384,6 +384,50 @@ def check_preempt(addr: str, timeout_s: float,
         f"{stats.get('yields', 0)} boundary yield(s)")
 
 
+def check_prof(addr: str, timeout_s: float,
+               defaulted: bool = False) -> bool:
+    """Contention-profiler probe (doc/observability.md "Locks, phases,
+    and profiles"): ``/prof`` must answer, and the dispatcher's phase
+    attribution must sum to >= 95% of measured under-lock span time —
+    validated client-side so a scheduler whose phase brackets drifted
+    out of :meth:`Dispatcher._step_inner` cannot self-report health."""
+    if not addr or addr == "none":
+        return _result("prof", "skip", "--scheduler none")
+    try:
+        snap = json.loads(_get(f"http://{addr}/prof", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("prof", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("prof", "skip", "scheduler predates /prof")
+        return _result("prof", "fail", f"{addr}: {exc}")
+    if not snap.get("enabled", True):
+        return _result("prof", "skip",
+                       f"{addr}: profiler disabled (--no-prof)")
+    disp = (snap.get("phases") or {}).get("dispatcher")
+    if not disp or not disp.get("spans"):
+        return _result("prof", "ok",
+                       f"{addr}: profiler live, no dispatcher steps yet")
+    span_s = float(disp.get("span_seconds", 0.0))
+    accounted = sum(float(v) for v in (disp.get("phases") or {}).values())
+    coverage = accounted / span_s if span_s > 0 else 1.0
+    if coverage < 0.95:
+        return _result(
+            "prof", "fail",
+            f"phase attribution covers {coverage * 100:.1f}% of "
+            f"{span_s:.3f}s under the dispatcher lock (< 95%) — a "
+            "phase bracket drifted out of Dispatcher._step_inner")
+    locks = snap.get("locks", [])
+    top = locks[0]["name"] if locks else "none"
+    return _result(
+        "prof", "ok",
+        f"{addr}: {disp['spans']} step(s), phases cover "
+        f"{coverage * 100:.1f}%, {len(locks)} tracked lock(s), "
+        f"top contended: {top}")
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -631,6 +675,7 @@ def main(argv=None) -> int:
     ok &= check_gangs(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_ledger(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_preempt(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_prof(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

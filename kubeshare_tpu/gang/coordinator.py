@@ -37,6 +37,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..obs import metrics as obs_metrics
+from ..obs import prof as obs_prof
 from ..obs.trace import get_tracer
 from ..utils.logger import get_logger
 
@@ -137,7 +138,9 @@ class GangTokenCoordinator:
         #: partial-preemption window, no hold-and-wait cycle.
         self.preempt = preempt
         self._rng = rng or random.Random(0xD1CE)
-        self._lock = threading.Condition()
+        # tracked (doc/observability.md): gang reserve/commit and
+        # pause windows all serialize here
+        self._lock = obs_prof.TrackedCondition("gangcoord")
         self._scheds: dict[str, object] = {}
         self._gangs: dict[str, _Gang] = {}
 

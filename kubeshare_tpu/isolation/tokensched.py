@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 
 from ..constants import BASE_QUOTA_MS, MIN_QUOTA_MS, WINDOW_MS
 from ..obs import metrics as obs_metrics
+from ..obs import prof as obs_prof
 from ..obs import slo as obs_slo
 from ..obs.flight import default_recorder as flight_default_recorder
 from ..obs.trace import get_tracer
@@ -342,7 +343,9 @@ class TokenScheduler:
                  clock=None, chip: str = "", ledger=None, blame=None,
                  ledger_clock=None, preempt=None):
         self._core = make_core(window_ms, base_quota_ms, min_quota_ms, native)
-        self._cond = threading.Condition()
+        # tracked (doc/observability.md): the Py façade's grant/
+        # release lock (the native core reports its own counters)
+        self._cond = obs_prof.TrackedCondition("tokensched")
         self._grants: dict[str, float] = {}  # name -> granted quota_ms
         # name -> FIFO of waiter tickets. A client is ONE token stream in
         # the core, but a pipelined connection dispatches gated ops

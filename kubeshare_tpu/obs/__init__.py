@@ -34,6 +34,11 @@ Four quarters:
   a trace ID from many sources and attributes a request's wall time to
   named segments (admission → queue-wait → schedule → grant-wait →
   transport → execute) for ``topcli --critpath``.
+- :mod:`.prof` — the runtime contention profiler: tracked locks
+  (wait/hold accounting, holder-site attribution), dispatcher phase
+  attribution, and a ``sys._current_frames()`` sampling wall profiler
+  with speedscope export; ``GET /prof`` and ``topcli --locks`` serve
+  its snapshot.
 
 See ``doc/observability.md`` for the full metric/span catalogue.
 """
@@ -47,6 +52,8 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       parse_exposition, prom_escape, quantile_from_buckets,
                       render_default, render_exposition, render_help_type,
                       render_sample)
+from .prof import (PhaseProfiler, StackSampler, TrackedCondition,
+                   TrackedLock, TrackedRLock)
 from .slo import (AlertEvent, SloError, SloEvaluator, SloSpec,
                   default_evaluator, parse_slo, set_default_evaluator)
 from .trace import (Span, Tracer, add_span_sink, get_tracer, install_tracer,
@@ -70,4 +77,6 @@ __all__ = [
     "default_evaluator", "parse_slo", "set_default_evaluator",
     "FlightRecorder", "default_recorder", "dump_jsonl",
     "install_crash_handler", "parse_dump_jsonl",
+    "PhaseProfiler", "StackSampler", "TrackedCondition", "TrackedLock",
+    "TrackedRLock",
 ]

@@ -313,6 +313,17 @@ class ServiceClient:
             raise RuntimeError(f"/ledger returned {code}")
         return body
 
+    def prof(self) -> dict:
+        """Runtime contention profiler snapshot (``GET /prof``,
+        doc/observability.md "Locks, phases, and profiles"): ranked
+        tracked-lock wait/hold table with holder sites, and dispatcher
+        phase attribution with coverage. RuntimeError when the
+        scheduler predates the profiler plane."""
+        code, body = self._call("GET", "/prof")
+        if code != 200:
+            raise RuntimeError(f"/prof returned {code}")
+        return body
+
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
         return self._call("DELETE", f"/pods/{namespace}/{name}")
 

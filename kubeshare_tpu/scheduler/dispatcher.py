@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 
 from .. import constants as C
 from ..obs import metrics as obs_metrics
+from ..obs import prof as obs_prof
 from ..obs.flight import default_recorder
 from ..obs.trace import get_tracer
 from ..topology.cell import reclaim_resource, reserve_resource
@@ -142,7 +143,16 @@ class Dispatcher:
         self.max_pending = max_pending
         self._clock = clock
         self._sync = sync               # callable(): refresh capacity
-        self._cond = threading.Condition()
+        # THE dispatcher lock (ROADMAP item 1): tracked so its
+        # wait/hold seconds and holder sites are measurable
+        # (doc/observability.md, "Locks, phases, and profiles"). Always
+        # on the wall clock — the injectable scheduler clock may be
+        # frozen, which would zero every hold.
+        self._cond = obs_prof.TrackedCondition("dispatcher")
+        #: per-phase attribution of the under-lock step time; the
+        #: doctor's /prof probe and bench-profile assert the phases
+        #: cover >= 95% of the measured span
+        self.prof_phases = obs_prof.PhaseProfiler("dispatcher")
         self._pending: dict[str, PodRequest] = {}
         self._retry_at: dict[str, float] = {}
         self._parked: dict[str, _Parked] = {}
@@ -383,9 +393,21 @@ class Dispatcher:
             return self._step_locked(self._clock() if now is None else now)
 
     def _step_locked(self, now: float) -> float:
+        # phase attribution (doc/observability.md): lap-timer brackets
+        # partition the whole under-lock span — queue-poll (GC, expiry,
+        # pick, bookkeeping) / healthwatch / slo / filter-score /
+        # publish / gang — so sharding work knows where lock-seconds go
+        span = self.prof_phases.span()
+        try:
+            return self._step_inner(now, span)
+        finally:
+            span.close("queue-poll")
+
+    def _step_inner(self, now: float, span) -> float:
         if now >= self._next_gc:
             self.engine.groups.gc()
             self._next_gc = now + self.gc_period_s
+        span.lap("queue-poll")
 
         if self.healthwatch is not None:
             try:
@@ -393,6 +415,7 @@ class Dispatcher:
             except Exception:
                 # detection must never take the scheduling loop with it
                 log.exception("healthwatch poll failed")
+            span.lap("healthwatch")
 
         if self.slo is not None:
             try:
@@ -401,14 +424,20 @@ class Dispatcher:
                 # same contract as healthwatch: alerting rides the loop,
                 # it must never crash it
                 log.exception("slo evaluation failed")
+            span.lap("slo")
         # black-box cadence: cheap counter deltas so a dump shows what
         # the dispatcher was doing in the seconds before the trigger
-        default_recorder().sample_deltas("dispatcher", {
+        rec = default_recorder()
+        rec.sample_deltas("dispatcher", {
             "queued": float(len(self._pending)),
             "parked": float(len(self._parked)),
             "requeues_total": _REQUEUES.value(),
             "timeouts_total": _TIMEOUTS.value(),
         })
+        # ... and the top lock-wait totals, so a dump on an SLO alert
+        # shows whether the control plane was lock-bound at that moment
+        if obs_prof.enabled():
+            rec.sample_deltas("lockcontention", obs_prof.top_wait_totals())
 
         for key in [k for k, p in self._parked.items() if p.deadline <= now]:
             if key in self._parked:     # may be gone via gang rejection
@@ -450,7 +479,8 @@ class Dispatcher:
                 pod = self._pending.pop(key)
                 self._retry_at.pop(key, None)  # stale entries would make
                 # the loop's next-event delay 0 forever (busy spin)
-                self._cycle(pod, now)
+                span.lap("queue-poll")
+                self._cycle(pod, now, span)
                 progressed = True
 
         # AFTER the pass (same-step binds must take effect immediately —
@@ -505,20 +535,24 @@ class Dispatcher:
                 best = key
         return best
 
-    def _cycle(self, pod: PodRequest, now: float) -> None:
+    def _cycle(self, pod: PodRequest, now: float,
+               span=obs_prof._NULL_SPAN) -> None:
         tracer = get_tracer()
         parent = pod.trace_span.span_id if pod.trace_span else ""
         ok, msg = self.engine.pre_filter(pod)
         if not ok:
             self._requeue(pod, now, msg)
+            span.lap("filter-score")
             return
         try:
             binding = self.engine.schedule(pod)
         except Unschedulable as e:
-            if self._maybe_preempt(pod, now):
-                return
-            self._requeue(pod, now, str(e))
+            preempted = self._maybe_preempt(pod, now)
+            if not preempted:
+                self._requeue(pod, now, str(e))
+            span.lap("filter-score")
             return
+        span.lap("filter-score")
         # queue-wait ends the moment a reservation succeeded. The wait is
         # measured on the scheduler clock (injectable in tests); the span
         # is back-dated on the tracer clock, clamped into the root span so
@@ -546,17 +580,20 @@ class Dispatcher:
                 # nor leak the fresh reservation — roll back and retry
                 self.engine.unreserve(pod)
                 self._requeue(pod, now, f"binding publish failed: {e}")
+                span.lap("publish")
                 return
         decision, timeout_s = self.engine.permit(pod)
         if decision == "wait":
             self._parked[pod.key] = _Parked(pod, binding, now + timeout_s,
                                             since=now)
             log.info("%s parked at gang barrier (%.1fs)", pod.key, timeout_s)
+            span.lap("gang")
             return
         _BIND_LAT.observe(value=time.perf_counter() - bind_t0)
         tracer.record("bind", pod.trace_id, bind_ts0, tracer.now_ms(),
                       parent_id=parent, node=binding.node)
         self._resolve(pod.key, Outcome("bound", binding=binding))
+        span.lap("publish")
         # the pod completing the barrier releases every parked member
         # (Allow all waiting group members, scheduler.go:577-584)
         if pod.group_name:
@@ -577,6 +614,7 @@ class Dispatcher:
                     pod=member.key)
                 self._resolve(key, Outcome("bound", binding=parked.binding))
             self._sync_gang(pod)
+            span.lap("gang")
 
     def _maybe_preempt(self, pod: PodRequest, now: float) -> bool:
         """A blocked guarantee pod may displace opportunistic pods

@@ -59,6 +59,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..obs import flight as obs_flight
+from ..obs import prof as obs_prof
 from ..obs import slo as obs_slo
 from ..telemetry.aggregator import sync_engine_from_registry
 from ..telemetry.registry import RegistryClient, TelemetryRegistry
@@ -267,6 +268,14 @@ class SchedulerService:
         snap["attached"] = True
         return snap
 
+    def prof_state(self) -> dict:
+        """``GET /prof`` body: per-lock wait/hold table + holder sites,
+        dispatcher phase attribution with coverage, enabled flag
+        (doc/observability.md, "Locks, phases, and profiles")."""
+        snap = obs_prof.snapshot()
+        snap["attached"] = True
+        return snap
+
     def flightrecorder_state(self) -> dict:
         """``GET /flightrecorder`` body: ring summary + latest dump."""
         rec = obs_flight.default_recorder()
@@ -281,6 +290,7 @@ class SchedulerService:
         Appends the process-wide obs registry (phase latencies, queue
         waits, bind latency, requeues) so one scrape sees everything."""
         from ..obs.metrics import render_default, render_help_type
+        obs_prof.sync_metrics()   # flush lock/phase accumulators first
         d = self.dispatcher
         with d.lock:
             lines = [
@@ -383,6 +393,8 @@ class SchedulerService:
                     return self._reply(200, svc.ledger_state())
                 if self.path == "/preempt":
                     return self._reply(200, svc.preempt_state())
+                if self.path == "/prof":
+                    return self._reply(200, svc.prof_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -518,6 +530,15 @@ def main(argv=None) -> None:
                              "requests preempt best-effort holders past "
                              "grace (gang-atomic for gangs); /preempt "
                              "exposes config + enforcement stats")
+    parser.add_argument("--prof", dest="prof", action="store_true",
+                        default=True,
+                        help="runtime contention profiler: tracked "
+                             "locks + dispatcher phase attribution on "
+                             "/prof (default on, bounded overhead — "
+                             "doc/observability.md)")
+    parser.add_argument("--no-prof", dest="prof", action="store_false",
+                        help="disable the contention profiler (tracked "
+                             "locks drop to delegated acquire/release)")
     parser.add_argument("--preempt-grace-ms", type=float, default=None,
                         help="how long a latency-class request waits "
                              "behind a lower-class holder before it is "
@@ -527,6 +548,7 @@ def main(argv=None) -> None:
     if args.flight_dump_dir:
         obs_flight.default_recorder().set_dump_dir(args.flight_dump_dir)
         obs_flight.default_recorder().set_dump_retention(args.flight_dump_cap)
+    obs_prof.set_enabled(args.prof)
     # an unhandled exception dumps the black box before the process dies
     obs_flight.install_crash_handler()
 

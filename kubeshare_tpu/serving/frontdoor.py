@@ -46,6 +46,7 @@ import numpy as np
 
 from ..scheduler.dispatcher import Overloaded
 from ..obs import flight as obs_flight
+from ..obs import prof as obs_prof
 from .accounting import ServingAccounting
 
 CLASSES = ("latency", "best-effort")
@@ -152,7 +153,11 @@ class FrontDoor:
         self.slo = slo
         self.recorder = (recorder if recorder is not None
                          else obs_flight.default_recorder())
-        self.lock = threading.Lock()
+        # tracked (doc/observability.md): admission, batching, and
+        # accounting all serialize under the front-door lock; the
+        # wakeup Condition shares the SAME tracked lock, so waits
+        # and holds account consistently on both routes
+        self.lock = obs_prof.TrackedLock("frontdoor")
         self.wakeup = threading.Condition(self.lock)
         self._tenants: Dict[str, _Tenant] = {}
         self._rr = {cls: 0 for cls in CLASSES}  # round-robin cursors

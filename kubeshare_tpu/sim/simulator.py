@@ -798,6 +798,11 @@ def main(argv=None) -> None:
                         metavar="NAME",
                         help="with --chaos: run only NAME (repeatable; "
                              "default: every scenario)")
+    parser.add_argument("--prof-report", action="store_true",
+                        help="append the runtime contention profiler "
+                             "snapshot (tracked locks + dispatcher "
+                             "phases, doc/observability.md) to the "
+                             "output JSON under 'prof'")
     args = parser.parse_args(argv)
 
     if sum(map(bool, (args.synthetic, args.trace, args.churn,
@@ -953,7 +958,11 @@ def main(argv=None) -> None:
             makespan_s=round(stats.makespan_s, 1))
         with open(args.flight_dump, "w") as f:
             f.write(obs_flight.dump_jsonl(dump))
-    print(json.dumps(stats.to_json()))
+    out = stats.to_json()
+    if args.prof_report:
+        from ..obs import prof as obs_prof
+        out["prof"] = obs_prof.snapshot()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

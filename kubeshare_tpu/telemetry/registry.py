@@ -56,6 +56,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
 from ..obs import metrics as obs_metrics
+from ..obs import prof as obs_prof
 # One exposition code path for the whole system: the canonical renderer
 # lives in obs.metrics; these names stay importable here for callers
 # that predate the obs package (collector.py, external tools).
@@ -78,7 +79,10 @@ class TelemetryRegistry:
     def __init__(self, journal: str | os.PathLike | None = None,
                  compact_every: int = 1000, clock=time.time,
                  tsdb: TimeSeriesStore | None = None):
-        self._lock = threading.Lock()
+        # tracked (doc/observability.md, "Locks, phases, and
+        # profiles"): the registry store serializes every push,
+        # query, and lease under this one lock
+        self._lock = obs_prof.TrackedLock("registry")
         self._clock = clock
         #: fleet TSDB behind POST /push + GET /query. Deliberately NOT
         #: journaled: decision state (capacity/pods/leases) must survive
@@ -312,6 +316,7 @@ class TelemetryRegistry:
         """Prometheus exposition, reference metric shapes
         (collector.go:30-35, aggregator.go:22-39) under TPU names, plus
         the process's self-metrics from the obs default registry."""
+        obs_prof.sync_metrics()   # flush lock accumulators into counters
         lines = render_help_type(
             "tpu_capacity", "gauge",
             "Schedulable chip inventory; chip identity in labels, "

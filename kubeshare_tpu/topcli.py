@@ -395,6 +395,30 @@ FLEET_GANG_PANELS = (
      "latest", None, "gang", ""),
 )
 
+#: (label, family, agg, q, group_label, unit) — the --fleet PREEMPT
+#: panel (the PR 13 preemption families, grouped per chip registry-side;
+#: remote-written since PR 13 but never rendered until now)
+FLEET_PREEMPT_PANELS = (
+    ("preempts", "kubeshare_preempt_total",
+     "increase", None, "chip", ""),
+    ("yield p99", "kubeshare_preempt_yield_seconds",
+     "quantile", 0.99, "chip", "s"),
+    ("boosts", "kubeshare_preempt_boost_grants_total",
+     "increase", None, "chip", ""),
+)
+
+#: (label, family, agg, q, group_label, unit) — the --fleet LOCKS panel
+#: (contention profiler families, grouped per tracked lock; the
+#: control-plane analogue of CONTENTION's chip-time blame)
+FLEET_LOCK_PANELS = (
+    ("wait s/s", "kubeshare_lock_waited_seconds_total",
+     "rate", None, "lock", "s/s"),
+    ("hold p99", "kubeshare_lock_hold_seconds",
+     "quantile", 0.99, "lock", "s"),
+    ("contended", "kubeshare_lock_contended_total",
+     "increase", None, "lock", ""),
+)
+
 #: (label, family, agg) — panels that get sparkline history in --watch
 FLEET_SPARKS = (
     ("rpc rate", "kubeshare_proxy_rpc_latency_seconds_count", "rate"),
@@ -492,6 +516,64 @@ def render_gangs(snap: dict) -> str:
             f"{g.get('grant_wait_p99_ms', 0.0):>6.1f}ms")
         for member in g.get("members", []):
             lines.append(f"      {member}")
+    return "\n".join(lines)
+
+
+def locks_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Contention profiler join view (doc/observability.md "Locks,
+    phases, and profiles"): the scheduler's ``GET /prof`` — ranked
+    tracked-lock wait/hold table, holder sites, dispatcher phases."""
+    snap: dict = {}
+    if scheduler is not None:
+        try:
+            snap = scheduler.prof()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "lock profile unavailable", file=sys.stderr)
+    return snap or {"attached": None, "locks": [], "phases": {}}
+
+
+def render_locks(snap: dict) -> str:
+    lines = ["LOCKS (runtime contention profiler, doc/observability.md)"]
+    if snap.get("attached") is None:
+        lines.append("  unavailable — name a scheduler with --scheduler")
+        return "\n".join(lines)
+    if not snap.get("enabled", True):
+        lines.append("  profiler disabled (--no-prof) — totals are "
+                     "frozen at the moment it was switched off")
+    locks = snap.get("locks", [])
+    if not locks:
+        lines.append("  no tracked locks have been acquired yet")
+    else:
+        lines.append(f"  {'LOCK':<14} {'ACQS':>9} {'CONTENDED':>10} "
+                     f"{'WAIT':>9} {'HELD':>9}  TOP HOLDER SITE")
+        for row in locks:
+            sites = row.get("top_sites", [])
+            top = sites[0]["site"] if sites else "-"
+            lines.append(
+                f"  {row.get('name', '?'):<14} "
+                f"{row.get('acquisitions', 0):>9} "
+                f"{row.get('contended', 0):>10} "
+                f"{_fmt_seconds(row.get('wait_total_s', 0.0)):>9} "
+                f"{_fmt_seconds(row.get('hold_total_s', 0.0)):>9}  {top}")
+            holder = row.get("holder")
+            if holder:
+                lines.append(
+                    f"      held NOW by {holder.get('thread', '?')} for "
+                    f"{holder.get('held_s', 0.0):.3f}s at "
+                    f"{holder.get('site', '?')}")
+    for name, ph in sorted((snap.get("phases") or {}).items()):
+        span_s = ph.get("span_seconds", 0.0)
+        lines.append(
+            f"  PHASES {name}: {ph.get('spans', 0)} span(s), "
+            f"{_fmt_seconds(span_s)} under lock, "
+            f"coverage {ph.get('coverage', 0.0) * 100:.1f}%")
+        phases = ph.get("phases", {})
+        for pname in sorted(phases, key=lambda p: -phases[p]):
+            share = phases[pname] / span_s if span_s else 0.0
+            lines.append(f"      {pname:<14} "
+                         f"{_fmt_seconds(phases[pname]):>9} "
+                         f"({share * 100:.1f}%)")
     return "\n".join(lines)
 
 
@@ -687,6 +769,32 @@ def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
         for g in res.get("groups", []):
             gid = g["labels"].get(group, "")
             gangs.setdefault(gid, {})[label] = g["value"]
+    # PREEMPT panel (doc/preempt.md): the PR 13 preemption families
+    # grouped per chip — same one-query-per-column shape as GANGS
+    preempt: dict[str, dict] = {}
+    for label, family, agg, q, group, unit in FLEET_PREEMPT_PANELS:
+        try:
+            res = client.query(family, agg=agg, window_s=window_s,
+                               q=q if q is not None else 0.99,
+                               by=(group,))
+        except Exception:
+            continue          # plane not pushing yet; the table stands
+        for g in res.get("groups", []):
+            gid = g["labels"].get(group, "")
+            preempt.setdefault(gid, {})[label] = g["value"]
+    # LOCKS panel (doc/observability.md "Locks, phases, and profiles"):
+    # tracked-lock wait rate / hold p99 / contended count per lock name
+    locks: dict[str, dict] = {}
+    for label, family, agg, q, group, unit in FLEET_LOCK_PANELS:
+        try:
+            res = client.query(family, agg=agg, window_s=window_s,
+                               q=q if q is not None else 0.99,
+                               by=(group,))
+        except Exception:
+            continue          # profiler not pushing yet; the table stands
+        for g in res.get("groups", []):
+            gid = g["labels"].get(group, "")
+            locks.setdefault(gid, {})[label] = g["value"]
     # CONTENTION panel (doc/observability.md): blame wait-seconds per
     # second, grouped by blamed tenant — who is costing the fleet time
     contention = []
@@ -704,7 +812,8 @@ def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
             "stale_after_s": inst.get("stale_after_s"),
             "window_s": float(window_s),
             "instances": instances, "panels": panels,
-            "gangs": gangs, "contention": contention}
+            "gangs": gangs, "preempt": preempt, "locks": locks,
+            "contention": contention}
 
 
 def fleet_history(client: RegistryClient, watch_s: float,
@@ -773,6 +882,36 @@ def render_fleet(snap: dict) -> str:
                 f"{_fmt_seconds(wait) if wait is not None else '-':>9} "
                 f"{partials if partials is not None else '-':>9} "
                 f"{'yes' if g.get('paused') else 'no':>7}")
+    preempt = snap.get("preempt") or {}
+    if preempt:
+        lines.append("PREEMPT (SLO-class preemptions, doc/preempt.md)")
+        lines.append(f"  {'chip':<28} {'preempts':>9} {'yield p99':>10} "
+                     f"{'boosts':>7}")
+        for cid in sorted(preempt):
+            p = preempt[cid]
+            yld = p.get("yield p99")
+            lines.append(
+                f"  {cid:<28} "
+                f"{p.get('preempts') if p.get('preempts') is not None else '-':>9} "
+                f"{_fmt_seconds(yld) if yld is not None else '-':>10} "
+                f"{p.get('boosts') if p.get('boosts') is not None else '-':>7}")
+    locks = snap.get("locks") or {}
+    if locks:
+        lines.append("LOCKS (tracked-lock contention, "
+                     "doc/observability.md — topcli --locks drills in)")
+        lines.append(f"  {'lock':<28} {'wait s/s':>9} {'hold p99':>9} "
+                     f"{'contended':>10}")
+        ranked = sorted(locks,
+                        key=lambda k: -(locks[k].get("wait s/s") or 0.0))
+        for lid in ranked:
+            row = locks[lid]
+            wait = row.get("wait s/s")
+            hold = row.get("hold p99")
+            lines.append(
+                f"  {lid:<28} "
+                f"{f'{wait:.3f}' if wait is not None else '-':>9} "
+                f"{_fmt_seconds(hold) if hold is not None else '-':>9} "
+                f"{row.get('contended') if row.get('contended') is not None else '-':>10}")
     contention = snap.get("contention") or []
     if contention:
         lines.append("CONTENTION (blame wait-seconds per second, by "
@@ -1029,6 +1168,12 @@ def main(argv=None) -> int:
                              "grant state, and gang grant-wait p50/p99 "
                              "(needs --scheduler for /gangs) instead of "
                              "the fleet table")
+    parser.add_argument("--locks", action="store_true",
+                        help="runtime contention profiler: ranked "
+                             "tracked-lock wait/hold table with top "
+                             "holder sites, plus dispatcher phase "
+                             "attribution (needs --scheduler for /prof) "
+                             "instead of the fleet table")
     parser.add_argument("--why", default=None, metavar="POD_OR_TENANT",
                         help="contention attribution: ranked 'who made "
                              "this pod/tenant wait' report joining the "
@@ -1107,6 +1252,10 @@ def main(argv=None) -> int:
                     gs = gangs_snapshot(client, scheduler)
                     out = (json.dumps(gs) if args.json
                            else render_gangs(gs))
+                elif args.locks:
+                    lks = locks_snapshot(client, scheduler)
+                    out = (json.dumps(lks) if args.json
+                           else render_locks(lks))
                 elif args.why:
                     ws = why_snapshot(client, scheduler, args.why)
                     out = (json.dumps(ws) if args.json

@@ -1,0 +1,396 @@
+"""Contention-profiler bench: what the profiler costs and whether its
+numbers can be trusted (doc/observability.md, "Locks, phases, and
+profiles").
+
+The profiler defaults ON (``--prof``), so its overhead budget is a
+promise, not a hope. Three legs, each a bar ``--check`` enforces:
+
+- **Overhead**: the bench_health admission-check hot loop — a full
+  bounded queue shedding 8-chip submits. Every ``submit`` is exactly
+  one tracked acquire/release of the dispatcher lock (measured, not
+  assumed), so the gated number is the tracked pair's enabled-vs-
+  disabled delta (a tight in-context A/B on that same lock) divided
+  by the per-check cost of the loop. A whole-loop A/B is also
+  reported, ungated — see :func:`run_overhead` for why differencing
+  two ~30us loop timings cannot resolve a ~0.5us effect on a shared
+  box. Bar: ``overhead_pct <= 2``.
+- **Phase coverage**: a mixed placeable/unplaceable workload stepped
+  through the dispatcher; the lap-timer phase brackets in
+  ``Dispatcher._step_inner`` must account for >= 95% of measured
+  under-lock span time (the same bar the doctor's ``/prof`` probe
+  checks on a live scheduler).
+- **Accuracy under churn**: the sim's ``--churn`` workload
+  (``synthesize_churn`` / ``churn_labels``) driven through a real
+  ``Dispatcher`` by contending submitter threads against a stepper
+  thread. Every outermost lock entry is also timed by a direct
+  ``perf_counter`` harness (the tracked lock is re-entrant, so the
+  dispatcher's own nested acquires stay un-double-counted). Bars: the
+  tracked-lock report names ``dispatcher`` as the top contended lock,
+  and its wait-seconds match the harness within 10%.
+
+Run: ``python scripts/bench_profile.py`` → one JSON object (committed
+as ``bench_profile.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers; ``--check`` exits 1 unless every
+bar holds (``make bench-profile`` does all three).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import statistics
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OVERHEAD_BAR_PCT = 2.0
+COVERAGE_BAR = 0.95
+ACCURACY_BAR_PCT = 10.0
+
+SUBMITS = 20000
+PAIR_ITERS = 100000
+PAIR_REPS = 7
+AB_ROUNDS = 6
+AB_CHUNK = 1500
+CHURN_SECONDS = 1.5
+CHURN_SUBMITTERS = 3
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _make_cluster(clock, hosts=2):
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    eng = SchedulerEngine(clock=clock)
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=hosts, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        eng.add_node(host, chips)
+    reg = TelemetryRegistry(clock=clock)
+    disp = Dispatcher(eng, reg, clock=clock, retry_backoff_s=1.0)
+    return eng, reg, disp
+
+
+def run_overhead() -> dict:
+    """Profiler overhead on bench_health's admission hot loop.
+
+    What the gate divides: the enabled-vs-disabled cost delta of one
+    tracked dispatcher-lock pair, over the per-check cost of the
+    admission loop as shipped. Those are the two individually-stable
+    quantities. The obvious alternative — time the whole loop with the
+    profiler off, then on, and difference — cannot resolve the effect
+    on a shared/virtualized box: the loop runs ~30us/check while the
+    profiler adds ~0.5us, and measured chunk-to-chunk swing here is
+    +-15% (scheduler noise plus dispatcher dicts growing mid-
+    measurement as every shed submit records an outcome). That A/B is
+    still computed below (ABBA chunk interleave, which cancels linear
+    drift) and reported as ``loop_ab_overhead_pct`` for reference,
+    but the gated ``overhead_pct`` comes from the quotient.
+
+    ``tracked_pairs_per_check`` is measured, not assumed, so the gate
+    breaks if the submit path ever grows a second tracked acquire.
+    The dispatcher's per-shed warning is quieted during measurement:
+    stderr formatting would fatten the denominator and *shrink* the
+    reported overhead — quieting it is the conservative choice.
+    """
+    import logging
+
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.obs import prof
+    from kubeshare_tpu.scheduler.dispatcher import Overloaded
+
+    huge = {C.POD_TPU_REQUEST: "8", C.POD_TPU_LIMIT: "8"}
+    displog = logging.getLogger("dispatcher")
+    level_before = displog.level
+
+    clock = _Clock()
+    eng, reg, disp = _make_cluster(clock)
+    disp.max_pending = 64
+    for i in range(64):                     # 8-chip asks never place
+        disp.submit(f"ns{i % 4}", f"p{i}", huge)
+    lock = disp._cond.tracked
+    seq = [0]
+
+    def submit_chunk(n: int) -> float:
+        base = seq[0]
+        seq[0] += n
+        t0 = time.perf_counter()
+        for i in range(n):
+            try:
+                disp.submit("fresh", f"x{base + i}", huge)
+            except Overloaded:
+                pass
+        return time.perf_counter() - t0
+
+    def pair_ns() -> float:
+        reps = []
+        for _ in range(PAIR_REPS):
+            t0 = time.perf_counter()
+            for _ in range(PAIR_ITERS):
+                with disp._cond:
+                    pass
+            reps.append((time.perf_counter() - t0) / PAIR_ITERS * 1e9)
+        return statistics.median(reps)
+
+    try:
+        displog.setLevel(logging.ERROR)
+        submit_chunk(2000)                  # warm caches + dict sizes
+
+        # how many tracked pairs does one admission check cost?
+        acqs0 = lock.acquisitions
+        submit_chunk(2000)
+        pairs_per_check = (lock.acquisitions - acqs0) / 2000.0
+
+        # denominator: per-check cost of the loop as shipped (prof on)
+        admission_s = submit_chunk(SUBMITS)
+        admission_us = admission_s / SUBMITS * 1e6
+
+        # numerator: the tracked pair's enabled-vs-disabled delta,
+        # measured on the very same lock the loop hammers
+        prof.set_enabled(False)
+        off_ns = pair_ns()
+        prof.set_enabled(True)
+        on_ns = pair_ns()
+        delta_ns = max(0.0, on_ns - off_ns)
+        overhead = (delta_ns * pairs_per_check) / (admission_us * 1e3) * 100.0
+
+        # reference-only loop A/B: ABBA chunks cancel linear drift, but
+        # the residual noise exceeds the signal — do not gate on this
+        ab = {False: 0.0, True: 0.0}
+        for _ in range(AB_ROUNDS):
+            prof.set_enabled(False)
+            ab[False] += submit_chunk(AB_CHUNK)
+            prof.set_enabled(True)
+            ab[True] += submit_chunk(AB_CHUNK)
+            ab[True] += submit_chunk(AB_CHUNK)
+            prof.set_enabled(False)
+            ab[False] += submit_chunk(AB_CHUNK)
+        loop_ab = (1.0 - ab[False] / ab[True]) * 100.0
+    finally:
+        prof.set_enabled(True)
+        displog.setLevel(level_before)
+
+    return {"admission_checks_per_sec": round(SUBMITS / admission_s),
+            "admission_us_per_check": round(admission_us, 2),
+            "tracked_pairs_per_check": round(pairs_per_check, 3),
+            "pair_ns_off": round(off_ns), "pair_ns_on": round(on_ns),
+            "pair_delta_ns": round(delta_ns),
+            "overhead_pct": round(overhead, 2),
+            "loop_ab_overhead_pct": round(loop_ab, 2),
+            "submits": SUBMITS}
+
+
+def run_phases() -> dict:
+    """Placeable + unplaceable load stepped through the dispatcher; the
+    lap-timer brackets must partition the measured span time."""
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.scheduler.dispatcher import Overloaded
+
+    clock = _Clock()
+    eng, reg, disp = _make_cluster(clock)
+    disp.max_pending = 256
+    rng = random.Random(7)
+    for i in range(160):
+        request = rng.choice((0.1, 0.25, 0.5, 8.0))
+        try:
+            disp.submit(f"t{i % 8}", f"c{i}",
+                        {C.POD_TPU_REQUEST: str(request),
+                         C.POD_TPU_LIMIT: str(max(1.0, request))})
+        except Overloaded:
+            pass
+        if i % 16 == 0:
+            clock.t += 2.0                  # past the retry backoff
+            disp.step()
+    for _ in range(20):
+        clock.t += 2.0
+        disp.step()
+    state = disp.prof_phases.state()
+    state["coverage"] = round(disp.prof_phases.coverage(), 4)
+    return state
+
+
+def run_churn() -> dict:
+    """sim --churn load through a real Dispatcher with contending
+    threads; every outermost lock entry carries a direct perf_counter
+    wait measurement to pin the tracked accounting against."""
+    from kubeshare_tpu.obs import prof
+    from kubeshare_tpu.scheduler.dispatcher import Overloaded
+    from kubeshare_tpu.sim.simulator import churn_labels, synthesize_churn
+
+    prof.reset_for_tests()                  # this section's locks only
+    clock = _Clock()
+    eng, reg, disp = _make_cluster(clock)
+    disp.max_pending = 256
+    lock = disp._cond.tracked
+    wait_before = lock.wait_total_s
+    deadline = time.perf_counter() + CHURN_SECONDS
+    direct = [0.0] * (CHURN_SUBMITTERS + 1)
+    stop = threading.Event()
+
+    def stepper():
+        while time.perf_counter() < deadline:
+            t0 = time.perf_counter()
+            with disp._cond:                # outermost: step's is nested
+                direct[0] += time.perf_counter() - t0
+                clock.t += 0.5              # past churn retry backoffs
+                disp.step(now=clock.t)
+            time.sleep(0.001)               # let submitters in
+        stop.set()
+
+    def submitter(idx: int):
+        rng = random.Random(100 + idx)
+        jobs = synthesize_churn(4096, rng)
+        for i, job in enumerate(jobs):
+            if stop.is_set():
+                break
+            t0 = time.perf_counter()
+            with disp._cond:                # outermost: submit's is nested
+                direct[idx] += time.perf_counter() - t0
+                try:
+                    disp.submit(f"churn{idx}", f"j{i}",
+                                churn_labels(job, rng))
+                except Overloaded:
+                    pass
+
+    threads = [threading.Thread(target=stepper, name="prof-bench-step")]
+    threads += [threading.Thread(target=submitter, args=(i,),
+                                 name=f"prof-bench-sub{i}")
+                for i in range(1, CHURN_SUBMITTERS + 1)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    tracked_s = lock.wait_total_s - wait_before
+    direct_s = sum(direct)
+    gap_pct = (abs(tracked_s - direct_s) / direct_s * 100.0
+               if direct_s > 0 else 0.0)
+    snap = prof.snapshot()
+    top = snap["locks"][0]["name"] if snap["locks"] else "none"
+    return {"top_lock": top,
+            "tracked_wait_s": round(tracked_s, 4),
+            "direct_wait_s": round(direct_s, 4),
+            "wait_gap_pct": round(gap_pct, 2),
+            "contended_acquires": lock.contended,
+            "submitters": CHURN_SUBMITTERS,
+            "duration_s": CHURN_SECONDS}
+
+
+def run_bench() -> dict:
+    return {"bench": "contention profiler: overhead on the admission "
+                     "hot loop, dispatcher phase coverage, tracked-"
+                     "wait accuracy under churn",
+            "overhead": run_overhead(),
+            "phases": run_phases(),
+            "churn": run_churn()}
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (ISSUE 15 / doc/observability.md)."""
+    bars = [
+        ("overhead.overhead_pct",
+         out["overhead"]["overhead_pct"] <= OVERHEAD_BAR_PCT,
+         f"profiler overhead on the admission hot loop must stay "
+         f"<= {OVERHEAD_BAR_PCT:.0f}%"),
+        ("phases.coverage",
+         out["phases"]["coverage"] >= COVERAGE_BAR,
+         f"phase attribution must cover >= {COVERAGE_BAR:.0%} of "
+         "measured under-lock span time"),
+        ("churn.top_lock", out["churn"]["top_lock"] == "dispatcher",
+         "the dispatcher lock must rank top contended under churn"),
+        ("churn.wait_gap_pct",
+         out["churn"]["wait_gap_pct"] <= ACCURACY_BAR_PCT,
+         f"tracked wait-seconds must match the direct timing harness "
+         f"within {ACCURACY_BAR_PCT:.0f}%"),
+        ("churn.contended_acquires",
+         out["churn"]["contended_acquires"] > 0,
+         "the churn run must actually contend (a contention bench "
+         "with zero contended acquires measured nothing)"),
+    ]
+    failed = [f"{name}: {why} (got {_lookup(out, name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _metric_keys(out: dict) -> list:
+    return ["overhead.admission_checks_per_sec",
+            "overhead.pair_delta_ns", "overhead.overhead_pct",
+            "phases.coverage", "churn.wait_gap_pct",
+            "churn.tracked_wait_s"]
+
+
+_HIGHER_IS_BETTER = ("overhead.admission_checks_per_sec",
+                     "phases.coverage")
+
+
+def _lookup(out: dict, key: str):
+    node = out
+    for part in key.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = _lookup(fresh, key), _lookup(base, key)
+        if new is None or old is None:
+            print(f"#   {key:40s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:40s} {old!s:>10} -> {new!s:>10}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_profile")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the <=2% overhead, >=95% "
+                             "phase-coverage, dispatcher-top-contended "
+                             "and <=10% wait-accuracy bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,8 +55,8 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 1
     # registry + fleetquery + scheduler + autopilot + serving + slo +
-    # invariants + gangs + ledger + preempt + leases all refuse
-    assert out.count("fail") == 11
+    # invariants + gangs + ledger + preempt + prof + leases all refuse
+    assert out.count("fail") == 12
 
 
 def test_doctor_cli_subprocess():
@@ -123,8 +123,8 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 1, out
     # registry + fleetquery + scheduler + autopilot + serving + slo +
-    # invariants + gangs + ledger + preempt + leases all refuse
-    assert out.count("fail") == 11, out
+    # invariants + gangs + ledger + preempt + prof + leases all refuse
+    assert out.count("fail") == 12, out
 
 
 def test_doctor_serving_probe_skip_then_ok(capsys, monkeypatch):

@@ -1,0 +1,327 @@
+"""Runtime contention profiler plane (doc/observability.md "Locks,
+phases, and profiles"): tracked-lock wait/hold accounting pinned
+against an injectable clock, Condition compatibility, dispatcher phase
+attribution, the sampling wall profiler, the remote-write → TSDB →
+``GET /query`` round trip for the ``kubeshare_lock_*`` /
+``kubeshare_prof_*`` families, and the ``/prof`` service surface."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubeshare_tpu.obs import flight as obs_flight
+from kubeshare_tpu.obs import prof
+from kubeshare_tpu.obs.metrics import collect_default
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.bridge import ServiceClient
+from kubeshare_tpu.scheduler.service import SchedulerService
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _fresh_prof():
+    prof.reset_for_tests()
+    yield
+    prof.reset_for_tests()
+
+
+# -- tracked locks -----------------------------------------------------------
+
+
+def test_uncontended_acquire_accounts_hold_only():
+    clock = _Clock()
+    lock = prof.TrackedLock("unit", clock=clock.now)
+    with lock:
+        clock.t += 1.5
+    assert lock.acquisitions == 1
+    assert lock.contended == 0
+    assert lock.wait_total_s == 0.0
+    assert lock.hold_total_s == pytest.approx(1.5)
+    # holder-site attribution named this function
+    sites = lock.top_sites()
+    assert sites and "test_uncontended_acquire" in sites[0]["site"]
+
+
+def test_threaded_contention_pinned_against_injectable_clock():
+    """The ISSUE's accounting pin: a waiter blocked behind a holder
+    records exactly the fake-clock seconds that elapsed while it
+    waited, and both holds land in hold_total_s."""
+    clock = _Clock()
+    lock = prof.TrackedLock("contend", clock=clock.now)
+    entered = threading.Event()
+    done = threading.Event()
+
+    def waiter():
+        entered.set()
+        with lock:                      # blocks: main thread holds
+            clock.t += 1.5              # waiter's own hold
+        done.set()
+
+    lock.acquire()
+    th = threading.Thread(target=waiter)
+    th.start()
+    entered.wait(5.0)
+    time.sleep(0.3)                     # waiter is parked in acquire()
+    clock.t += 2.5                      # fake seconds spent waiting
+    lock.release()
+    assert done.wait(5.0)
+    th.join(5.0)
+
+    assert lock.acquisitions == 2
+    assert lock.contended == 1
+    assert lock.wait_total_s == pytest.approx(2.5)
+    # main held 2.5 (while the clock advanced), waiter held 1.5
+    assert lock.hold_total_s == pytest.approx(4.0)
+
+
+def test_rlock_reentrancy_accounts_outermost_pair_only():
+    clock = _Clock()
+    lock = prof.TrackedRLock("reent", clock=clock.now)
+    with lock:
+        clock.t += 1.0
+        with lock:                      # nested: no new accounting
+            clock.t += 2.0
+        clock.t += 3.0
+    assert lock.acquisitions == 1
+    assert lock.hold_total_s == pytest.approx(6.0)
+    with pytest.raises(RuntimeError):
+        lock.release()                  # not owned
+
+
+def test_tracked_condition_wait_notify_roundtrip():
+    """TrackedCondition (the dispatcher/gang/tokensched wrapper) keeps
+    full Condition semantics: wait() drops a re-entrant hold so the
+    notifier can get in, then restores it."""
+    cond = prof.TrackedCondition("cv")
+    state = {"go": False}
+
+    def notifier():
+        with cond:
+            state["go"] = True
+            cond.notify_all()
+
+    with cond:
+        with cond:                      # re-entrant hold, then wait
+            threading.Thread(target=notifier).start()
+            assert cond.wait_for(lambda: state["go"], timeout=5.0)
+    assert cond.tracked.acquisitions >= 1
+
+
+def test_condition_over_tracked_plain_lock_frontdoor_pattern():
+    """The serving front door shares ONE TrackedLock between `lock` and
+    a threading.Condition — Condition must adopt the tracked lock's
+    _is_owned and account exactly one hold for the critical section."""
+    clock = _Clock()
+    lock = prof.TrackedLock("door", clock=clock.now)
+    wakeup = threading.Condition(lock)
+    with lock:
+        clock.t += 0.25
+        wakeup.notify_all()             # requires _is_owned() to be true
+    assert lock.hold_total_s == pytest.approx(0.25)
+
+
+def test_disabled_profiler_freezes_accounting():
+    clock = _Clock()
+    lock = prof.TrackedLock("off", clock=clock.now)
+    phases = prof.PhaseProfiler("off", wall=clock.now)
+    prof.set_enabled(False)
+    try:
+        with lock:
+            clock.t += 9.0
+        span = phases.span()
+        clock.t += 9.0
+        span.close("tail")
+        assert lock.acquisitions == 0 and lock.hold_total_s == 0.0
+        assert phases.spans == 0 and phases.phase_totals == {}
+        assert prof.snapshot()["enabled"] is False
+    finally:
+        prof.set_enabled(True)
+
+
+# -- phase attribution -------------------------------------------------------
+
+
+def test_phase_profiler_partitions_span_with_full_coverage():
+    clock = _Clock()
+    phases = prof.PhaseProfiler("disp", wall=clock.now)
+    span = phases.span()
+    clock.t += 1.0
+    span.lap("queue-poll")
+    clock.t += 2.0
+    span.lap("filter-score")
+    clock.t += 3.0
+    span.close("publish")
+    assert phases.spans == 1
+    assert phases.span_total_s == pytest.approx(6.0)
+    assert phases.phase_totals == pytest.approx(
+        {"queue-poll": 1.0, "filter-score": 2.0, "publish": 3.0})
+    # lap-timer semantics: every instant lands in exactly one phase
+    assert phases.coverage() == pytest.approx(1.0)
+    state = phases.state()
+    assert state["coverage"] >= 0.95    # the doctor/bench bar
+
+
+# -- sampling wall profiler --------------------------------------------------
+
+
+def test_stack_sampler_folded_and_speedscope():
+    parked = threading.Event()
+    entered = threading.Event()
+
+    def camper():
+        entered.set()
+        parked.wait(10.0)
+
+    th = threading.Thread(target=camper, name="prof-test-camper")
+    th.start()
+    entered.wait(5.0)
+    sampler = prof.StackSampler(interval_s=0.01)
+    try:
+        for _ in range(3):
+            assert sampler.sample_once() >= 1
+        folded = sampler.folded()
+        assert "prof-test-camper" in folded
+        assert "camper" in folded       # outermost-first frame chain
+        assert ";wait" in folded        # parked in Event.wait
+        scope = sampler.speedscope()
+        assert scope["$schema"].startswith("https://www.speedscope.app")
+        names = {f["name"] for f in scope["shared"]["frames"]}
+        assert "camper" in names
+        for profile in scope["profiles"]:
+            assert profile["type"] == "sampled"
+            assert len(profile["samples"]) == len(profile["weights"])
+        # weights are seconds at the configured interval
+        camp = [p for p in scope["profiles"]
+                if p["name"] == "prof-test-camper"]
+        assert camp and camp[0]["endValue"] == pytest.approx(0.03)
+    finally:
+        parked.set()
+        th.join(5.0)
+
+
+def test_stack_sampler_thread_start_stop(tmp_path):
+    sampler = prof.StackSampler(interval_s=0.005).start()
+    time.sleep(0.1)
+    sampler.stop()
+    assert sampler.samples >= 2
+    out = tmp_path / "prof.speedscope.json"
+    sampler.export_speedscope(str(out))
+    assert json.loads(out.read_text())["profiles"]
+
+
+# -- flight recorder + fleet round trip --------------------------------------
+
+
+def test_top_wait_totals_feed_lockcontention_deltas():
+    clock = _Clock()
+    hot = prof.TrackedLock("hot", clock=clock.now)
+    cold = prof.TrackedLock("cold", clock=clock.now)
+    hot.wait_total_s = 4.0              # accounting already pinned above
+    cold.wait_total_s = 1.0
+    totals = prof.top_wait_totals()
+    assert list(totals) == ["hot", "cold"]
+
+    rec = obs_flight.FlightRecorder(capacity=64)
+    rec.sample_deltas("lockcontention", totals, min_interval_s=0.0)
+    hot.wait_total_s = 6.5
+    rec.sample_deltas("lockcontention", prof.top_wait_totals(),
+                      min_interval_s=0.0)
+    dump = rec.trigger("test")
+    rows = [e for e in dump["entries"]
+            if e.get("subsystem") == "lockcontention"]
+    assert rows, dump
+    # the second sample carries the wait DELTA, not the total
+    assert rows[-1]["deltas"]["hot"] == pytest.approx(2.5)
+
+
+def test_lock_and_prof_families_survive_remote_write_roundtrip():
+    """kubeshare_lock_* / kubeshare_prof_* must survive the full fleet
+    path: accumulator → sync_metrics → collect_default (remote-write
+    shape) → TelemetryRegistry TSDB → GET /query aggregation — the
+    same path the topcli LOCKS fleet panel reads."""
+    clock = _Clock()
+    lock = prof.TrackedLock("roundtrip", clock=clock.now)
+    with lock:
+        clock.t += 3.0
+    phases = prof.PhaseProfiler("roundtrip", wall=clock.now)
+    span = phases.span()
+    clock.t += 2.0
+    span.close("queue-poll")
+    prof.sync_metrics()
+
+    reg = TelemetryRegistry()
+    try:
+        stored = reg.push_metrics("sched-0", "scheduler",
+                                  snapshot=collect_default())
+        assert stored > 0
+        res = reg.tsdb.query("kubeshare_lock_held_seconds_total",
+                             agg="latest", window_s=60, by=("lock",))
+        held = {g["labels"]["lock"]: g["value"]
+                for g in res["groups"]}
+        assert held["roundtrip"] == pytest.approx(3.0)
+        res = reg.tsdb.query("kubeshare_prof_phase_seconds_total",
+                             agg="latest", window_s=60, by=("phase",))
+        by_phase = {g["labels"]["phase"]: g["value"]
+                    for g in res["groups"]}
+        assert by_phase["queue-poll"] >= 2.0
+    finally:
+        reg.close()
+
+
+# -- service surface ---------------------------------------------------------
+
+
+def _make_service():
+    eng = SchedulerEngine()
+    reg = TelemetryRegistry()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=2, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        reg.put_capacity(host, [c.to_labels() for c in chips])
+    svc = SchedulerService(eng, reg, replay=False)
+    svc.serve()
+    return svc
+
+
+def test_prof_endpoint_and_service_client():
+    svc = _make_service()
+    try:
+        client = ServiceClient(f"http://127.0.0.1:{svc.port}")
+        body = client.prof()
+        assert body["attached"] is True
+        assert body["enabled"] is True
+        names = {row["name"] for row in body["locks"]}
+        # the wired hot locks: dispatcher lock + registry store at least
+        assert "dispatcher" in names
+        assert "registry" in names
+        assert "dispatcher" in body["phases"]
+        # /metrics exposes the profiler families on the same process
+        import urllib.request
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics", timeout=5).read()
+        assert b"kubeshare_lock_acquisitions_total" in text
+    finally:
+        svc.close()
+
+
+def test_doctor_prof_probe_against_live_service():
+    from kubeshare_tpu.doctor import check_prof
+    svc = _make_service()
+    try:
+        # step the dispatcher so phase spans exist, then probe
+        svc.dispatcher.step(now=time.monotonic())
+        assert check_prof(f"127.0.0.1:{svc.port}", 5.0) is True
+    finally:
+        svc.close()

@@ -253,6 +253,129 @@ def test_cli_fleet_view_aggregates_across_instances(capsys):
         srv.shutdown()
 
 
+def test_cli_fleet_preempt_and_locks_panels(capsys):
+    """--fleet PREEMPT + LOCKS: the PR 13 preemption families and the
+    contention-profiler lock families are remote-written by the
+    scheduler and rendered as per-chip / per-lock panels — one GET
+    /query per column, registry-side (the gap this PR closes for
+    PREEMPT, same shape PR 11 closed for GANGS)."""
+    import time
+    from kubeshare_tpu.telemetry.registry import RegistryClient
+
+    reg, srv, _ = serve_fleet()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    cli = RegistryClient("127.0.0.1", srv.server_address[1])
+    t = time.time()
+
+    def push(now, preempts, boosts, waited, contended, yields, holds):
+        samples = [
+            ("kubeshare_preempt_total",
+             {"chip": "chip-0", "waiter_class": "latency",
+              "holder_class": "best-effort"}, float(preempts)),
+            ("kubeshare_preempt_boost_grants_total",
+             {"chip": "chip-0", "kind": "beneficiary"}, float(boosts)),
+            ("kubeshare_lock_waited_seconds_total",
+             {"lock": "dispatcher"}, float(waited)),
+            ("kubeshare_lock_contended_total",
+             {"lock": "dispatcher"}, float(contended)),
+        ]
+        for fam, label, per_le in (
+                ("kubeshare_preempt_yield_seconds", {"chip": "chip-0"},
+                 yields),
+                ("kubeshare_lock_hold_seconds", {"lock": "dispatcher"},
+                 holds)):
+            for le, c in per_le.items():
+                samples.append((fam + "_bucket", dict(label, le=le),
+                                float(c)))
+            samples.append((fam + "_sum", label, 1.0))
+            samples.append((fam + "_count", label,
+                            float(per_le.get("+Inf", 0))))
+        cli.push_metrics("sched-0", "scheduler", snapshot={
+            "families": {
+                "kubeshare_preempt_total": "counter",
+                "kubeshare_preempt_boost_grants_total": "counter",
+                "kubeshare_lock_waited_seconds_total": "counter",
+                "kubeshare_lock_contended_total": "counter",
+                "kubeshare_preempt_yield_seconds": "histogram",
+                "kubeshare_lock_hold_seconds": "histogram",
+            }, "samples": samples}, now=now)
+
+    try:
+        push(t - 10.0, 0, 0, 0.0, 0,
+             {"0.01": 0, "0.1": 0, "+Inf": 0},
+             {"0.001": 0, "0.01": 0, "+Inf": 0})
+        push(t, 3, 5, 1.25, 7,
+             {"0.01": 40, "0.1": 90, "+Inf": 100},
+             {"0.001": 60, "0.01": 95, "+Inf": 100})
+
+        assert topcli.main(["--registry", addr, "--fleet"]) == 0
+        out = capsys.readouterr().out
+        assert "PREEMPT" in out and "LOCKS" in out
+        assert "chip-0" in out              # preempt panel row, per chip
+        assert "dispatcher" in out          # lock panel row, per lock
+
+        assert topcli.main(["--registry", addr, "--fleet", "--json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["preempt"]["chip-0"]["preempts"] == 3.0
+        assert snap["preempt"]["chip-0"]["boosts"] == 5.0
+        assert snap["preempt"]["chip-0"]["yield p99"] > 0.0
+        assert snap["locks"]["dispatcher"]["contended"] == 7.0
+        assert snap["locks"]["dispatcher"]["wait s/s"] > 0.0
+        assert snap["locks"]["dispatcher"]["hold p99"] > 0.0
+    finally:
+        srv.shutdown()
+
+
+def test_render_locks_view():
+    """topcli --locks: ranked tracked-lock table with holder sites and
+    dispatcher phase attribution, from the /prof body."""
+    snap = {
+        "attached": True, "enabled": True,
+        "locks": [{
+            "name": "dispatcher", "acquisitions": 120, "contended": 7,
+            "wait_total_s": 1.25, "hold_total_s": 3.5,
+            "holder": {"thread": "worker-0", "held_s": 0.002,
+                       "site": "step (dispatcher.py:392)"},
+            "top_sites": [{"site": "step (dispatcher.py:392)",
+                           "held_s": 3.0}],
+        }],
+        "phases": {"dispatcher": {
+            "spans": 10, "span_seconds": 3.4, "coverage": 0.99,
+            "phases": {"queue-poll": 2.0, "publish": 1.4}}},
+    }
+    out = topcli.render_locks(snap)
+    assert "dispatcher" in out
+    assert "step (dispatcher.py:392)" in out
+    assert "held NOW by worker-0" in out
+    assert "coverage 99.0%" in out
+    assert "queue-poll" in out
+    # no scheduler named: the view says how to get one
+    assert "--scheduler" in topcli.render_locks({"attached": None})
+
+
+def test_cli_locks_view_against_live_scheduler(capsys):
+    """--locks end-to-end: topcli dials the scheduler's /prof via
+    ServiceClient and renders the wired hot locks."""
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.service import SchedulerService
+
+    reg, srv, _ = serve_fleet()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    eng = SchedulerEngine()
+    svc = SchedulerService(eng, TelemetryRegistry(), replay=False)
+    svc.serve()
+    try:
+        assert topcli.main(["--registry", addr,
+                            "--scheduler", f"127.0.0.1:{svc.port}",
+                            "--locks"]) == 0
+        out = capsys.readouterr().out
+        assert "LOCKS (runtime contention profiler" in out
+        assert "dispatcher" in out
+    finally:
+        svc.close()
+        srv.shutdown()
+
+
 def test_cli_fleet_empty_registry_degrades(capsys):
     reg, srv, _ = serve_fleet()
     addr = f"127.0.0.1:{srv.server_address[1]}"
