@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay image clean obs-check
 
 all: native
 
@@ -156,6 +156,16 @@ bench-preempt:
 bench-profile:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_profile.py --check \
 		--baseline bench_profile.json --write bench_profile.json
+
+# Decision-replay bench (doc/replay.md): record a churn workload's
+# decision trace, replay it through the same and a perturbed build;
+# --check gates record->replay bit-identity, a non-empty named diff
+# on the perturbation, the 1h-trace-in-<60s replay speed bar and the
+# <=2%-of-admission recorder overhead bar, then refreshes
+# bench_replay.json.
+bench-replay:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_replay.py --check \
+		--baseline bench_replay.json --write bench_replay.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -60,6 +60,14 @@ class Autopilot:
                       moves=len(plan["moves"]),
                       frag_before=plan["fragmentation_before"],
                       frag_after=plan["fragmentation_after"])
+        dec = getattr(self.dispatcher, "decisions", None)
+        if dec is not None:
+            dec.record("plan", now,
+                       moves=[{"pod": m["pod"], "from": m["from"],
+                               "node": m["node"]}
+                              for m in plan.get("moves", [])],
+                       frag_before=plan["fragmentation_before"],
+                       frag_after=plan["fragmentation_after"])
         self.last_plan = plan
         return plan
 
@@ -71,6 +79,12 @@ class Autopilot:
         if plan is None:
             plan = self.last_plan or {"moves": []}
         result = self.rebalancer.apply(plan)
+        dec = getattr(self.dispatcher, "decisions", None)
+        if dec is not None:
+            dec.record("apply",
+                       applied=list(result.get("applied", [])),
+                       rolled_back=list(result.get("rolled_back", [])),
+                       failed=list(result.get("failed", [])))
         self.last_apply = result
         return result
 

@@ -198,7 +198,7 @@ class Planner:
         before returning. The returned plan is pure data — feed it to
         Rebalancer.apply (or a human) unchanged."""
         now = self._clock() if now is None else now
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()        # wall-clock: metric-only
         d = self.dispatcher
         with d.lock:
             eng = d.engine
@@ -275,5 +275,6 @@ class Planner:
             elif not moves:
                 plan["reason"] = "no improving move"
         _MOVES.inc("planned", amount=float(len(plan["moves"])))
-        _PLAN_LAT.observe(value=time.perf_counter() - t0)
+        _PLAN_LAT.observe(
+            value=time.perf_counter() - t0)  # wall-clock: metric-only
         return plan

@@ -428,6 +428,39 @@ def check_prof(addr: str, timeout_s: float,
         f"top contended: {top}")
 
 
+def check_decisions(addr: str, timeout_s: float,
+                    defaulted: bool = False) -> bool:
+    """Decision-recorder probe (doc/replay.md): ``/decisions`` must
+    answer with a live ring — the recorder is always on, so a missing
+    or empty-capacity state on a current scheduler is a wiring
+    regression, not a skip."""
+    if not addr or addr == "none":
+        return _result("decisions", "skip", "--scheduler none")
+    try:
+        state = json.loads(_get(f"http://{addr}/decisions", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("decisions", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("decisions", "skip",
+                           "scheduler predates /decisions")
+        return _result("decisions", "fail", f"{addr}: {exc}")
+    if not state.get("attached") or not state.get("capacity"):
+        return _result("decisions", "fail",
+                       f"{addr}: decision recorder not attached — the "
+                       "replay plane is wired in "
+                       "SchedulerService.__init__, this is a regression")
+    kinds = state.get("kinds", {})
+    return _result(
+        "decisions", "ok",
+        f"{addr}: {state.get('seq', 0)} decision(s) recorded "
+        f"({state.get('ring_len', 0)}/{state.get('capacity')} in ring, "
+        f"{state.get('dropped', 0)} dropped, "
+        f"{len(kinds)} kind(s))")
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -676,6 +709,7 @@ def main(argv=None) -> int:
     ok &= check_ledger(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_preempt(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_prof(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_decisions(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

@@ -89,6 +89,9 @@ class PreemptionPolicy:
         self.grace_ms = float(grace_ms)
         self.min_hold_ms = float(min_hold_ms)
         self.enabled = bool(enabled)
+        #: optional decision recorder: token/gang preemptions land in
+        #: the replayable decision trace (doc/replay.md)
+        self.decisions = None
         self._lock = threading.Lock()
         self._stats = {
             "preemptions": 0,
@@ -122,6 +125,11 @@ class PreemptionPolicy:
             by[holder] = by.get(holder, 0) + 1
         _PREEMPTIONS.inc(chip, waiter_class or "best-effort",
                          holder_class or "best-effort")
+        if self.decisions is not None:
+            self.decisions.record("token-preempt", chip=chip,
+                                  holder=holder,
+                                  waiter_class=waiter_class,
+                                  holder_class=holder_class)
 
     def note_yield(self, chip: str, yield_s: float,
                    reclaimed_ms: float) -> None:
@@ -144,6 +152,9 @@ class PreemptionPolicy:
         with self._lock:
             self._stats["gang_preemptions"] += 1
         _GANG.inc(gang, beneficiary)
+        if self.decisions is not None:
+            self.decisions.record("gang-preempt", gang=gang,
+                                  beneficiary=beneficiary)
 
     # -- views --------------------------------------------------------
 

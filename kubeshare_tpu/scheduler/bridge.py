@@ -294,6 +294,16 @@ class ServiceClient:
             raise RuntimeError(f"/flightrecorder returned {code}")
         return body
 
+    def decisions(self) -> dict:
+        """Decision-recorder summary (``GET /decisions``,
+        doc/replay.md): ring fill, per-kind decision counts, recent
+        tail. RuntimeError when the scheduler predates the replay
+        plane."""
+        code, body = self._call("GET", "/decisions")
+        if code != 200:
+            raise RuntimeError(f"/decisions returned {code}")
+        return body
+
     def gangs(self) -> dict:
         """Gang isolation plane snapshot (``GET /gangs``, doc/gang.md):
         membership, grant state, grant-wait percentiles per gang.

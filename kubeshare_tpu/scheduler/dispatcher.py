@@ -177,6 +177,10 @@ class Dispatcher:
         #: chip→member membership at bind/unbind so gang-atomic grants
         #: span exactly the bound sub-mesh (doc/gang.md)
         self.gangcoord = None
+        #: decision flight recorder (attach_decisions): every submit,
+        #: terminal outcome, preemption plan, eviction and move lands
+        #: in its ring as a replayable trace (doc/replay.md)
+        self.decisions = None
         self.shed_total = 0
         self._next_gc = 0.0
         self._stop = False
@@ -214,6 +218,43 @@ class Dispatcher:
         coordinator's registry always mirrors the bound sub-mesh."""
         self.gangcoord = coord
         return self
+
+    def attach_decisions(self, rec) -> "Dispatcher":
+        """Wire a :class:`~..obs.decisions.DecisionRecorder`: the
+        decision path (submit, resolve, preempt, evict, move) records a
+        replayable trace (doc/replay.md). Recording opens with a
+        ``fleet`` entry — the engine's current chip inventory, what the
+        shadow replayer rebuilds the candidate cluster from — and the
+        engine's trace-id entropy is routed through the recorder so
+        replay draws the same ids."""
+        self.decisions = rec
+        self.engine.decisions = rec
+        with self._cond:
+            nodes = {}
+            for node, models in sorted(self.engine.chips_by_node.items()):
+                chips = sorted((c for chips_ in models.values()
+                                for c in chips_),
+                               key=lambda c: c.chip_id)
+                nodes[node] = [c.to_labels() for c in chips]
+            rec.record("fleet", self._clock(), nodes=nodes)
+        return self
+
+    def _decision_view(self) -> dict:
+        """Compact capacity/health view ``{node: "free|health"}`` for
+        the decision trace's delta-encoded ``view`` entries (caller
+        holds the lock)."""
+        eng = self.engine
+        view = {}
+        for node, models in eng.chips_by_node.items():
+            free = 0.0
+            for chips_ in models.values():
+                for c in chips_:
+                    cell = eng.leaf_cells.get(c.chip_id)
+                    if cell is not None:
+                        free += cell.available
+            view[node] = "%.3f|%s" % (
+                free, "up" if eng.node_health.get(node) else "down")
+        return view
 
     def _sync_gang(self, pod: PodRequest) -> None:
         """Publish the CURRENT bound membership of *pod*'s gang to the
@@ -296,7 +337,25 @@ class Dispatcher:
         tracer = get_tracer()
         adm_t0 = tracer.now_ms()
         with self._cond:
-            self._check_admission(namespace, name)
+            dec = self.decisions
+            if dec is None:
+                self._check_admission(namespace, name)
+            else:
+                try:
+                    self._check_admission(namespace, name)
+                except Overloaded as shed:
+                    # ONE entry on the shed path (it IS the admission
+                    # hot loop, bench_replay gates its cost): the
+                    # submit input and its denial together, spec
+                    # included so replay can re-drive the shed
+                    dec.record("submit", self._clock(),
+                               pod=f"{namespace}/{name}",
+                               labels=dict(labels), uid=uid,
+                               shed=shed.reason)
+                    raise
+                dec.record("submit", self._clock(),
+                           pod=f"{namespace}/{name}",
+                           labels=dict(labels), uid=uid)
             pod = self.engine.submit(namespace, name, labels, uid=uid)
             # the critical path's first segment: admission control +
             # label parse + enqueue, under the pod's fresh trace id
@@ -324,6 +383,8 @@ class Dispatcher:
         """Pod removal: reclaim + drop from every queue
         (deletePod, pod.go:91-136)."""
         with self._cond:
+            if self.decisions is not None:
+                self.decisions.record("delete", self._clock(), pod=key)
             pod = self.engine.pod_status.get(key)
             self._pending.pop(key, None)
             self._retry_at.pop(key, None)
@@ -438,6 +499,14 @@ class Dispatcher:
         # shows whether the control plane was lock-bound at that moment
         if obs_prof.enabled():
             rec.sample_deltas("lockcontention", obs_prof.top_wait_totals())
+        if self.decisions is not None:
+            # capacity/health view delta into the decision trace, and
+            # the per-kind decision counts into the black box (delta
+            # samples are their own rate limit: unchanged counts record
+            # nothing)
+            self.decisions.record_view(now, self._decision_view())
+            rec.sample_deltas("decision", {
+                k: float(v) for k, v in self.decisions.counts().items()})
 
         for key in [k for k, p in self._parked.items() if p.deadline <= now]:
             if key in self._parked:     # may be gone via gang rejection
@@ -568,7 +637,7 @@ class Dispatcher:
             wait_start = max(wait_start, pod.trace_span.start_ms)
         tracer.record("queue-wait", pod.trace_id, wait_start, wait_end,
                       parent_id=parent, pod=pod.key)
-        bind_t0 = time.perf_counter()
+        bind_t0 = time.perf_counter()   # wall-clock: metric-only
         bind_ts0 = tracer.now_ms()
         if self.registry is not None and pod.needs_tpu:
             from ..telemetry.aggregator import publish_binding
@@ -589,7 +658,8 @@ class Dispatcher:
             log.info("%s parked at gang barrier (%.1fs)", pod.key, timeout_s)
             span.lap("gang")
             return
-        _BIND_LAT.observe(value=time.perf_counter() - bind_t0)
+        _BIND_LAT.observe(
+            value=time.perf_counter() - bind_t0)  # wall-clock: metric-only
         tracer.record("bind", pod.trace_id, bind_ts0, tracer.now_ms(),
                       parent_id=parent, node=binding.node)
         self._resolve(pod.key, Outcome("bound", binding=binding))
@@ -654,6 +724,10 @@ class Dispatcher:
         if fresh:
             log.info("%s preempts %d opportunistic pod(s) on %s: %s",
                      pod.key, len(fresh), plan["node"], ", ".join(fresh))
+        if self.decisions is not None:
+            self.decisions.record("preempt", now, pod=pod.key,
+                                  node=plan["node"],
+                                  victims=sorted(plan["victims"]))
         self._requeue(pod, now,
                       f"preempting {len(plan['victims'])} opportunistic "
                       f"pod(s) on {plan['node']}")
@@ -785,6 +859,9 @@ class Dispatcher:
             try:
                 binding = self._rebind_locked(pod, node)
                 self._sync_gang(pod)
+                if self.decisions is not None:
+                    self.decisions.record("move", now, pod=key, src=source,
+                                          dst=node)
                 return binding
             except Unschedulable as move_err:
                 pod.group_rank = rank
@@ -916,6 +993,9 @@ class Dispatcher:
                     self._sync_gang(pod)
         log.warning("node %s lost: evicted %d pod(s): %s", node,
                     len(evicted), ", ".join(evicted))
+        if self.decisions is not None:
+            self.decisions.record("evict", now, node=node, reason=reason,
+                                  pods=list(evicted))
         # a node loss is a black-box trigger: dump what the system was
         # doing in the run-up (doc/observability.md, flight recorder)
         rec = default_recorder()
@@ -959,6 +1039,15 @@ class Dispatcher:
             log.warning("withdraw %s failed: %s", key, e)
 
     def _resolve(self, key: str, outcome: Outcome) -> None:
+        if self.decisions is not None and outcome.status != "overloaded":
+            # overloaded already rode its single shed submit entry
+            # (submit(), hot-path economy); everything else is a
+            # decision output the replay diff compares
+            self.decisions.record(
+                "outcome", self._clock(), pod=key, status=outcome.status,
+                reason=outcome.reason,
+                node=(outcome.binding.node if outcome.binding is not None
+                      else ""))
         if self.slo is not None and outcome.status in (
                 "bound", "rejected", "timed-out"):
             # availability SLI: did the tenant's pod reach bound?

@@ -70,12 +70,12 @@ def _timed_phase(phase: str):
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()    # wall-clock: metric-only
             try:
                 return fn(*args, **kwargs)
             finally:
                 _PHASE_LAT.observe(phase,
-                                   value=time.perf_counter() - t0)
+                    value=time.perf_counter() - t0)  # wall-clock: metric-only
         return wrapper
     return deco
 
@@ -189,6 +189,10 @@ class SchedulerEngine:
         self.mesh_shape = mesh_shape
         self._clock = clock
         self._fleet_snapshot: tuple | None = None
+        #: decision recorder (set by Dispatcher.attach_decisions): when
+        #: present, trace-id entropy is drawn through it so a shadow
+        #: replay reproduces the recorded ids (doc/replay.md)
+        self.decisions = None
         self.rebuild_count = 0   # topology rebuilds since start
         #: bumped whenever chip capacity can have changed (bookings,
         #: reclaims, topology/health changes) — consumed by the gang
@@ -356,7 +360,9 @@ class SchedulerEngine:
         # root span of the pod's timeline: opened here, closed at
         # delete_pod; everything downstream (queue-wait, filter, reserve,
         # bind, token-grant) keys off this trace ID
-        pod.trace_id = new_trace_id()
+        pod.trace_id = (new_trace_id() if self.decisions is None  # entropy: recorded
+                        else self.decisions.rng_draw_hex(
+                            "trace-id", pod.timestamp))
         pod.trace_span = get_tracer().begin("submit", pod.trace_id,
                                             pod=pod.key)
         if pod.slo_specs:
@@ -1009,7 +1015,7 @@ class SchedulerEngine:
             raise Unschedulable(f"{pod.key}: {msg}")
         candidates = []
         with tracer.span("filter", pod.trace_id, parent) as fspan:
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()    # wall-clock: metric-only
             for node in (nodes if nodes is not None else self.nodes):
                 fit, why = self.filter(pod, node)
                 if fit:
@@ -1017,14 +1023,16 @@ class SchedulerEngine:
                 else:
                     log.debug("filter: %s rejected %s: %s",
                               node, pod.key, why)
-            _PHASE_LAT.observe("filter", value=time.perf_counter() - t0)
+            _PHASE_LAT.observe("filter",
+                value=time.perf_counter() - t0)  # wall-clock: metric-only
             fspan.attrs["candidates"] = len(candidates)
         if not candidates:
             raise Unschedulable(f"{pod.key}: no node passed filtering")
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()        # wall-clock: metric-only
         raw = {node: self.score(pod, node) for node in candidates}
         norm = self.normalize_scores(raw)
-        _PHASE_LAT.observe("score", value=time.perf_counter() - t0)
+        _PHASE_LAT.observe("score",
+            value=time.perf_counter() - t0)  # wall-clock: metric-only
         # Walk candidates best-first: a reserve-time refusal (select_cells
         # sees different constraints than the filter DFS, e.g. raced
         # capacity) falls back to the next-ranked node instead of aborting

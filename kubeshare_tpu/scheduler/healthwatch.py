@@ -82,8 +82,11 @@ class HealthWatch:
                  recover_k: int = C.HEALTH_RECOVER_K,
                  quarantine_s: float = C.HEALTH_QUARANTINE_S,
                  poll_period_s: float | None = None,
-                 migrate_fn=None):
+                 migrate_fn=None, clock=time.time):
         self.registry = registry
+        #: snapshot-default timestamp source — injectable so replay and
+        #: sims never read the wall clock on the decision path
+        self._clock = clock
         self.ttl_s = float(ttl_s)
         self.miss_threshold = int(miss_threshold)
         self.recover_k = int(recover_k)
@@ -102,6 +105,9 @@ class HealthWatch:
         self._last_ages: dict[str, float] = {}
         self._next_poll = 0.0
         self.evicted_total = 0
+        #: decision recorder borrowed from the dispatcher each poll;
+        #: transitions are replay inputs (doc/replay.md)
+        self._decisions = None
 
     # -- lease reading -----------------------------------------------------
 
@@ -124,6 +130,7 @@ class HealthWatch:
         if now < self._next_poll:
             return []
         self._next_poll = now + self.poll_period_s
+        self._decisions = getattr(dispatcher, "decisions", None)
         try:
             leases = self._read_leases()
         except Exception as e:
@@ -183,6 +190,9 @@ class HealthWatch:
     def _transition(self, st: NodeState, node: str, state: str, now: float,
                     changed: list[str]) -> None:
         log.info("%s: %s -> %s", node, st.state, state)
+        if self._decisions is not None:
+            self._decisions.record("node-health", now, node=node,
+                                   state=state, prev=st.state)
         st.state = state
         st.last_transition = now
         _TRANSITIONS.inc(state)
@@ -207,6 +217,6 @@ class HealthWatch:
     def snapshot(self, now: float | None = None) -> dict:
         """Per-node health for /health and ``kubeshare-top --health``."""
         if now is None:
-            now = time.time()
+            now = self._clock()
         return {node: st.to_dict(now, self._last_ages.get(node, 0.0))
                 for node, st in sorted(self.nodes.items())}

@@ -110,6 +110,12 @@ class SchedulerService:
         # preemption plane (kubeshare_tpu.preempt, ROADMAP item 1):
         # None until attach_preempt — GET /preempt reports detached
         self.preempt = None
+        # decision flight recorder (doc/replay.md): always on, like the
+        # SLO plane — every placement decision this service makes is a
+        # replayable trace on GET /decisions
+        from ..obs.decisions import default_decisions
+        self.decisions = default_decisions()
+        self.dispatcher.attach_decisions(self.decisions)
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
@@ -154,6 +160,7 @@ class SchedulerService:
         stats."""
         self.preempt = policy
         self.gangcoord.preempt = policy
+        policy.decisions = self.decisions
         return self
 
     # -- operations --------------------------------------------------------
@@ -283,6 +290,11 @@ class SchedulerService:
         state["last"] = rec.last_dump()
         return state
 
+    def decisions_state(self) -> dict:
+        """``GET /decisions`` body: decision-recorder summary — ring
+        fill, per-kind counts, recent tail (doc/replay.md)."""
+        return self.decisions.state()
+
     def render_metrics(self) -> str:
         """Scheduler-side Prometheus exposition (the reference's only
         scheduler observability is log lines; SURVEY §5). Complements the
@@ -395,6 +407,8 @@ class SchedulerService:
                     return self._reply(200, svc.preempt_state())
                 if self.path == "/prof":
                     return self._reply(200, svc.prof_state())
+                if self.path == "/decisions":
+                    return self._reply(200, svc.decisions_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})

@@ -89,6 +89,32 @@ def churn_labels(job: TraceJob, rng: random.Random) -> dict:
     return {C.POD_TPU_REQUEST: str(request), C.POD_TPU_LIMIT: "1.0"}
 
 
+def churn_events(n: int, seed: int = 0,
+                 horizon_s: float | None = None) -> list[dict]:
+    """The churn workload as replay-harness events (doc/replay.md):
+    each :func:`synthesize_churn` job becomes a ``submit`` at its
+    chained offset plus a ``delete`` at submit + runtime, so the
+    recorded decision trace carries the same arrival/departure tearing
+    the autopilot churn runs use. ``horizon_s`` drops events past that
+    virtual time (after generation, so a prefix of a long trace is a
+    prefix of the same job sequence)."""
+    rng = random.Random(seed)
+    jobs = synthesize_churn(n, rng)
+    events: list[dict] = []
+    t = 0.0
+    for i, job in enumerate(jobs):
+        t += job.offset_s
+        events.append({"t": round(t, 3), "op": "submit",
+                       "namespace": f"tenant-{i % 4}",
+                       "name": f"churn-{i}",
+                       "labels": churn_labels(job, rng)})
+        events.append({"t": round(t + job.runtime_s, 3), "op": "delete",
+                       "key": f"tenant-{i % 4}/churn-{i}"})
+    if horizon_s is not None:
+        events = [e for e in events if e["t"] <= horizon_s]
+    return events
+
+
 #: synthetic per-process tracer epochs for --critpath, in ms. Deliberately
 #: huge and distinct: real processes' monotonic epochs are incomparable,
 #: and the critpath assembler must attribute by durations alone — a run

@@ -1086,6 +1086,53 @@ def _critpath_main(args) -> int:
     return 0 if traces else 2
 
 
+def _replay_diff_main(args) -> int:
+    """Offline decision-diff render: either a saved diff report (the
+    ``decision_diff`` JSON ``bench_replay`` and ``trigger_on_diff``
+    emit) or a pair of decision traces to diff on the spot."""
+    from .obs.decisions import parse_trace_jsonl
+    from .replay import decision_diff, render_diff
+
+    try:
+        with open(args.replay_diff) as fh:
+            text = fh.read()
+    except OSError as e:
+        print(f"kubeshare-top: --replay-diff: {e}", file=sys.stderr)
+        return 2
+    try:
+        first = text.lstrip().splitlines()[0] if text.strip() else ""
+        doc = json.loads(first) if first.startswith("{") else None
+    except ValueError:
+        doc = None
+    if doc is not None and doc.get("kind") == "header":
+        # a decision trace, not a diff — needs the counterpart trace
+        if not args.against:
+            print("kubeshare-top: --replay-diff got a decision trace; "
+                  "pass the candidate trace via --against FILE",
+                  file=sys.stderr)
+            return 2
+        try:
+            with open(args.against) as fh:
+                other = fh.read()
+        except OSError as e:
+            print(f"kubeshare-top: --against: {e}", file=sys.stderr)
+            return 2
+        diff = decision_diff(parse_trace_jsonl(text)["entries"],
+                             parse_trace_jsonl(other)["entries"])
+    else:
+        try:
+            diff = json.loads(text)
+        except ValueError as e:
+            print(f"kubeshare-top: --replay-diff: not a diff report or "
+                  f"decision trace: {e}", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps(diff))
+    else:
+        print(render_diff(diff))
+    return 0 if diff.get("identical") else 1
+
+
 def _opportunistic(priority: str) -> bool:
     """Match the scheduler's rule: priority <= 0 is opportunistic
     (``scheduler/labels.py``), not just the literal "0"."""
@@ -1198,9 +1245,20 @@ def main(argv=None) -> int:
     parser.add_argument("--window", type=float, default=60.0,
                         help="aggregation window in seconds for --fleet "
                              "and watch-mode --latency (default 60)")
+    parser.add_argument("--replay-diff", default=None, metavar="FILE",
+                        help="offline: render a decision-diff report "
+                             "(bench_replay/trigger_on_diff JSON), or "
+                             "diff a recorded decision trace against "
+                             "--against TRACE; exits 1 on a non-empty "
+                             "diff (doc/replay.md)")
+    parser.add_argument("--against", default=None, metavar="TRACE",
+                        help="candidate decision trace for --replay-diff "
+                             "when FILE is itself a trace")
     args = parser.parse_args(argv)
     if args.critpath:
         return _critpath_main(args)
+    if args.replay_diff:
+        return _replay_diff_main(args)
     host, _, port = args.registry.rpartition(":")
     client = RegistryClient(host or "127.0.0.1", int(port))
     scheduler = None

@@ -1,0 +1,363 @@
+"""Replay-plane bench: the decision recorder's cost and the shadow
+replayer's trustworthiness (doc/replay.md).
+
+Four legs, each a bar ``--check`` enforces:
+
+- **Bit-identity**: a churn workload recorded through the harness and
+  replayed on the SAME build must reproduce the trace byte for byte
+  (``trace_fingerprint`` equality) with an empty decision diff — the
+  regression gate a scheduler PR runs before and after its change.
+- **Perturbation**: the same trace replayed through a candidate engine
+  whose scoring is nudged on one node must yield a NON-empty diff
+  whose rendering names moved pods — a replayer that cannot see a
+  planted behavior change would pass every real change too.
+- **Speed**: a 1-hour virtual churn trace must replay in < 60 s wall
+  (the whole point of shadow replay is that an hour of history is a
+  coffee-break check, not an hour).
+- **Overhead**: recording must cost <= 2% of an admission check on the
+  shed hot loop — same gate discipline as ``bench_profile``: the gated
+  number is the quotient of two individually-stable measurements (the
+  per-record cost of ``DecisionRecorder.record`` times the measured
+  records-per-check, over the per-check cost of the loop as shipped),
+  because a whole-loop A/B cannot resolve a sub-microsecond effect on
+  a ~30 us loop on a shared box. The loop A/B is still reported,
+  ungated, as ``loop_ab_overhead_pct``.
+
+Run: ``python scripts/bench_replay.py`` → one JSON object (committed
+as ``bench_replay.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers; ``--check`` exits 1 unless every
+bar holds (``make bench-replay`` does all three).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BIT_IDENTITY_REQUIRED = True
+SPEED_BAR_WALL_S = 60.0
+SPEED_VIRTUAL_S = 3600.0
+OVERHEAD_BAR_PCT = 2.0
+
+CHURN_JOBS = 400            # bit-identity + perturbation workload
+HOUR_JOBS = 2600            # generated, then cut at the 1h horizon
+HOUR_TICK_S = 0.25          # recorded in the trace meta; replay obeys it
+SUBMITS = 20000             # overhead denominator loop
+RECORD_ITERS = 50000
+RECORD_REPS = 7
+AB_ROUNDS = 6
+AB_CHUNK = 1500
+SEED = 7
+
+
+def _fleet(n_nodes=4, mesh=(2, 2)):
+    """{node: [chip labels]} via FakeTopology — the harness fleet."""
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=n_nodes, mesh=mesh).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    return {host: [c.to_labels() for c in chips]
+            for host, chips in by_host.items()}
+
+
+def _nudged_factory(node_suffix="-0", bonus=50.0):
+    """Candidate engine build with one node's score nudged up — the
+    planted perturbation the diff must catch."""
+    from kubeshare_tpu.scheduler.engine import SchedulerEngine
+
+    class NudgedEngine(SchedulerEngine):
+        def score(self, pod, node):
+            s = super().score(pod, node)
+            return s + (bonus if node.endswith(node_suffix) else 0.0)
+
+    return lambda clock: NudgedEngine(clock=clock)
+
+
+def run_identity() -> dict:
+    """Record a churn trace, replay it on the same build: bytes equal."""
+    from kubeshare_tpu.obs.decisions import trace_jsonl
+    from kubeshare_tpu.replay import (decision_diff, record_trace,
+                                      replay_trace)
+    from kubeshare_tpu.sim.simulator import churn_events
+
+    events = churn_events(CHURN_JOBS, seed=SEED)
+    fleet = _fleet()
+    rec = record_trace(events, fleet, seed=SEED)
+    txt = trace_jsonl(rec)
+    rep = replay_trace(txt)
+    diff = decision_diff(rec.entries(), rep.entries())
+    return {"events": len(events),
+            "entries": len(rec.entries()),
+            "trace_bytes": len(txt),
+            "bit_identical": diff["bit_identical"],
+            "identical": diff["identical"],
+            "pods": diff["pods"]["recorded"]}
+
+
+def run_perturbation() -> dict:
+    """Replay the same trace through a score-nudged candidate: the diff
+    must be non-empty and its rendering human-readable."""
+    from kubeshare_tpu.replay import (decision_diff, record_trace,
+                                      render_diff, replay_trace)
+    from kubeshare_tpu.sim.simulator import churn_events
+
+    events = churn_events(CHURN_JOBS, seed=SEED)
+    rec = record_trace(events, _fleet(), seed=SEED)
+    rep = replay_trace(rec, engine_factory=_nudged_factory())
+    diff = decision_diff(rec.entries(), rep.entries())
+    text = render_diff(diff)
+    return {"bit_identical": diff["bit_identical"],
+            "identical": diff["identical"],
+            "moved": len(diff["moved"]),
+            "denied": len(diff["denied"]),
+            "delayed": len(diff["delayed"]),
+            "render_lines": len(text.splitlines()),
+            "render_names_moves": "moved" in text,
+            "render_head": text.splitlines()[:6]}
+
+
+def run_speed() -> dict:
+    """One virtual hour of churn, recorded then replayed; the replay
+    wall time is the gated number."""
+    from kubeshare_tpu.replay import record_trace, replay_trace
+    from kubeshare_tpu.replay.shadow import replay_wall_seconds
+    from kubeshare_tpu.sim.simulator import churn_events
+
+    events = churn_events(HOUR_JOBS, seed=SEED, horizon_s=SPEED_VIRTUAL_S)
+    virtual_s = max(e["t"] for e in events)
+    # fleet sized to the workload's steady state (~44 chips of demand):
+    # an hour of 3x-overloaded churn would spend its ticks re-scoring a
+    # permanent backlog, measuring the scheduler's thrash, not replay
+    fleet = _fleet(n_nodes=16)
+    rec, record_wall = replay_wall_seconds(
+        lambda: record_trace(events, fleet, seed=SEED, tick_s=HOUR_TICK_S))
+    rep, replay_wall = replay_wall_seconds(lambda: replay_trace(rec))
+    return {"events": len(events),
+            "entries": len(rec.entries()),
+            "virtual_s": round(virtual_s, 1),
+            "tick_s": HOUR_TICK_S,
+            "record_wall_s": round(record_wall, 3),
+            "replay_wall_s": round(replay_wall, 3),
+            "speedup_x": round(virtual_s / replay_wall
+                               if replay_wall > 0 else float("inf"))}
+
+
+def run_overhead() -> dict:
+    """Recorder cost on the admission shed hot loop, quotient-gated.
+
+    Numerator: per-call cost of ``DecisionRecorder.record`` (median of
+    reps, measured against a full ring so deque displacement is paid)
+    times the measured records-per-check (seq delta over a submit
+    chunk — breaks loudly if the shed path ever grows a second entry).
+    Denominator: per-check cost of the loop as shipped (recorder
+    attached). The dispatcher's per-shed warning is quieted: stderr
+    formatting would fatten the denominator and shrink the reported
+    overhead."""
+    import logging
+
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.obs.decisions import DecisionRecorder
+    from kubeshare_tpu.replay.shadow import VirtualClock, build_cluster
+    from kubeshare_tpu.scheduler.dispatcher import Overloaded
+
+    huge = {C.POD_TPU_REQUEST: "8", C.POD_TPU_LIMIT: "8"}
+    displog = logging.getLogger("dispatcher")
+    level_before = displog.level
+
+    clock = VirtualClock(100.0)
+    eng, disp = build_cluster(clock, _fleet(n_nodes=2),
+                              {"max_pending": 64})
+    rec = DecisionRecorder(capacity=8192, clock=clock, seed=SEED)
+    disp.attach_decisions(rec)
+    for i in range(64):                     # 8-chip asks never place
+        disp.submit(f"ns{i % 4}", f"p{i}", huge)
+    seq_base = [0]
+
+    def submit_chunk(n: int) -> float:
+        base = seq_base[0]
+        seq_base[0] += n
+        t0 = time.perf_counter()
+        for i in range(n):
+            try:
+                disp.submit("fresh", f"x{base + i}", huge)
+            except Overloaded:
+                pass
+        return time.perf_counter() - t0
+
+    def record_ns() -> float:
+        reps = []
+        lbl = dict(huge)
+        for _ in range(RECORD_REPS):
+            t0 = time.perf_counter()
+            for _ in range(RECORD_ITERS):
+                rec.record("submit", 100.0, pod="fresh/x", labels=lbl,
+                           uid="", shed="max-pending")
+            reps.append((time.perf_counter() - t0) / RECORD_ITERS * 1e9)
+        # min, not median: the gate bounds the recorder's intrinsic
+        # cost, and the quotient method already makes the bar tight —
+        # scheduler/GC noise in the numerator would flake CI
+        return min(reps)
+
+    try:
+        displog.setLevel(logging.ERROR)
+        submit_chunk(2000)                  # warm caches + full ring
+
+        # how many entries does one admission check record?
+        s0 = rec.state()["seq"]
+        submit_chunk(2000)
+        records_per_check = (rec.state()["seq"] - s0) / 2000.0
+
+        # denominator: per-check cost of the loop as shipped
+        admission_s = submit_chunk(SUBMITS)
+        admission_us = admission_s / SUBMITS * 1e6
+
+        # numerator: the per-record cost, measured on the same recorder
+        per_record_ns = record_ns()
+        overhead = (per_record_ns * records_per_check) \
+            / (admission_us * 1e3) * 100.0
+
+        # reference-only loop A/B (ABBA cancels linear drift; residual
+        # noise exceeds the signal — reported, not gated)
+        ab = {False: 0.0, True: 0.0}
+        for _ in range(AB_ROUNDS):
+            disp.decisions = None
+            ab[False] += submit_chunk(AB_CHUNK)
+            disp.decisions = rec
+            ab[True] += submit_chunk(AB_CHUNK)
+            ab[True] += submit_chunk(AB_CHUNK)
+            disp.decisions = None
+            ab[False] += submit_chunk(AB_CHUNK)
+        disp.decisions = rec
+        loop_ab = (1.0 - ab[False] / ab[True]) * 100.0
+    finally:
+        displog.setLevel(level_before)
+
+    return {"admission_checks_per_sec": round(SUBMITS / admission_s),
+            "admission_us_per_check": round(admission_us, 2),
+            "records_per_check": round(records_per_check, 3),
+            "record_ns": round(per_record_ns),
+            "overhead_pct": round(overhead, 2),
+            "loop_ab_overhead_pct": round(loop_ab, 2),
+            "submits": SUBMITS}
+
+
+def run_bench() -> dict:
+    return {"bench": "decision replay: record/replay bit-identity, "
+                     "diff on a planted perturbation, 1h-trace replay "
+                     "speed, recorder overhead on the admission loop",
+            "identity": run_identity(),
+            "perturbation": run_perturbation(),
+            "speed": run_speed(),
+            "overhead": run_overhead()}
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (ISSUE 16 / doc/replay.md)."""
+    bars = [
+        ("identity.bit_identical",
+         out["identity"]["bit_identical"] is True,
+         "record -> replay on the same build must be bit-identical"),
+        ("identity.identical",
+         out["identity"]["identical"] is True,
+         "the same-build decision diff must be empty"),
+        ("perturbation.identical",
+         out["perturbation"]["identical"] is False,
+         "a score-nudged candidate must produce a NON-empty diff"),
+        ("perturbation.moved",
+         out["perturbation"]["moved"] > 0,
+         "the planted score nudge must move at least one pod"),
+        ("perturbation.render_names_moves",
+         out["perturbation"]["render_names_moves"] is True,
+         "render_diff must name the moved pods (human-readable gate)"),
+        ("speed.virtual_s",
+         out["speed"]["virtual_s"] >= SPEED_VIRTUAL_S * 0.95,
+         "the speed leg must actually cover ~1 virtual hour"),
+        ("speed.replay_wall_s",
+         out["speed"]["replay_wall_s"] < SPEED_BAR_WALL_S,
+         f"a 1-hour churn trace must replay in < "
+         f"{SPEED_BAR_WALL_S:.0f}s wall"),
+        ("overhead.overhead_pct",
+         out["overhead"]["overhead_pct"] <= OVERHEAD_BAR_PCT,
+         f"recorder overhead on the admission hot loop must stay "
+         f"<= {OVERHEAD_BAR_PCT:.0f}%"),
+    ]
+    failed = [f"{name}: {why} (got {_lookup(out, name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _metric_keys(out: dict) -> list:
+    return ["identity.entries", "perturbation.moved",
+            "speed.replay_wall_s", "speed.speedup_x",
+            "overhead.admission_checks_per_sec", "overhead.record_ns",
+            "overhead.overhead_pct"]
+
+
+_HIGHER_IS_BETTER = ("speed.speedup_x",
+                     "overhead.admission_checks_per_sec")
+
+
+def _lookup(out: dict, key: str):
+    node = out
+    for part in key.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = _lookup(fresh, key), _lookup(base, key)
+        if new is None or old is None:
+            print(f"#   {key:40s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:40s} {old!s:>10} -> {new!s:>10}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_replay")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the bit-identity, non-empty "
+                             "perturbation diff, <60s 1h-replay and "
+                             "<=2% recorder-overhead bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,291 @@
+"""Decision flight recorder + shadow replay (doc/replay.md): recorder
+hot path, view delta-encoding, recorded entropy, torn-tail trace
+recovery, record→replay bit-identity under churn, a planted
+perturbation showing up as a non-empty human-readable diff, the
+``GET /decisions`` service surface, and the explicit-now lint over the
+decision-path modules."""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from kubeshare_tpu.obs import decisions as dmod
+from kubeshare_tpu.obs.decisions import (
+    DecisionRecorder, apply_view_delta, canonical_entry,
+    fingerprint_labels, parse_trace_jsonl, reconstruct_views,
+    trace_jsonl)
+from kubeshare_tpu.replay import (
+    decision_diff, record_trace, render_diff, replay_trace,
+    trigger_on_diff)
+from kubeshare_tpu.scheduler import SchedulerEngine
+from kubeshare_tpu.scheduler.bridge import ServiceClient
+from kubeshare_tpu.scheduler.service import SchedulerService
+from kubeshare_tpu.sim.simulator import churn_events
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_default():
+    dmod.reset_for_tests()
+    yield
+    dmod.reset_for_tests()
+
+
+def _fleet(hosts=4, mesh=(2, 2)):
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=hosts, mesh=mesh).chips():
+        by_host.setdefault(chip.host, []).append(chip.to_labels())
+    return by_host
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_record_assigns_seq_and_explicit_now():
+    rec = DecisionRecorder(capacity=8, clock=lambda: 99.0)
+    e1 = rec.record("submit", 1.5, pod="a/b", labels={}, uid="")
+    e2 = rec.record("outcome", pod="a/b", status="bound", reason="",
+                    node="n0")
+    assert (e1["seq"], e2["seq"]) == (1, 2)
+    assert e1["t"] == 1.5
+    assert e2["t"] == 99.0          # clock fallback when now is omitted
+    assert rec.counts() == {"submit": 1, "outcome": 1}
+
+
+def test_ring_bounds_memory_and_derives_drop_count():
+    rec = DecisionRecorder(capacity=4)
+    for i in range(10):
+        rec.record("submit", float(i), pod=f"t/p{i}", labels={}, uid="")
+    assert len(rec.entries()) == 4
+    assert rec.dropped == 6
+    assert [e["seq"] for e in rec.entries()] == [7, 8, 9, 10]
+    # counts survive ring eviction (they feed flight-recorder deltas)
+    assert rec.counts()["submit"] == 10
+    st = rec.state()
+    assert st["attached"] and st["capacity"] == 4 and st["seq"] == 10
+
+
+def test_view_delta_encoding_round_trip():
+    rec = DecisionRecorder(capacity=64)
+    full = [
+        {"n0": "4.000|up", "n1": "4.000|up"},
+        {"n0": "3.000|up", "n1": "4.000|up"},          # n0 changed
+        {"n0": "3.000|up", "n1": "4.000|up"},          # no change: no entry
+        {"n0": "3.000|up"},                            # n1 removed
+        {"n0": "0.000|down", "n2": "4.000|up"},
+    ]
+    recorded = [rec.record_view(float(i), v) for i, v in enumerate(full)]
+    assert recorded == [True, True, False, True, True]
+    views = reconstruct_views(rec.entries())
+    assert views == [full[0], full[1], full[3], full[4]]
+    # deltas are minimal: the second entry only carries the changed key
+    second = [e for e in rec.entries() if e["kind"] == "view"][1]
+    assert second["set"] == {"n0": "3.000|up"} and second["drop"] == []
+    assert apply_view_delta(full[0], second) == full[1]
+
+
+def test_rng_draws_are_seeded_recorded_and_primeable():
+    a = DecisionRecorder(seed=7)
+    b = DecisionRecorder(seed=7)
+    assert [a.rng_draw("x", 0.0) for _ in range(3)] \
+        == [b.rng_draw("x", 0.0) for _ in range(3)]
+    assert a.rng_draw_hex("trace-id", 0.0) == b.rng_draw_hex("trace-id", 0.0)
+    # a replayer primed with the recorded draws gets those back, even
+    # with a different seed — entropy cannot silently diverge
+    c = DecisionRecorder(seed=999)
+    c.prime_draws([e for e in a.entries() if e["kind"] == "rng"])
+    vals = [e["value"] for e in a.entries() if e["kind"] == "rng"][:3]
+    assert [c.rng_draw("x") for _ in range(3)] == vals
+
+
+def test_canonical_entry_is_idempotent_and_fingerprints_submits():
+    e = {"kind": "submit", "t": 1.23456789012, "seq": 1, "pod": "a/b",
+         "labels": {"kubeshare/tpu-request": "0.5"}, "uid": ""}
+    c1 = canonical_entry(e)
+    assert c1["t"] == 1.234568
+    assert c1["fp"] == fingerprint_labels(e["labels"])
+    assert canonical_entry(c1) == c1
+    assert "fp" not in e            # original untouched
+
+
+# -- trace serialization -----------------------------------------------------
+
+
+def _tiny_trace():
+    rec = DecisionRecorder(capacity=64, seed=3)
+    rec.record("fleet", 0.0, nodes=_fleet(1))
+    rec.record("submit", 0.1, pod="t/p0",
+               labels={"kubeshare/tpu-request": "1"}, uid="u0")
+    rec.record("outcome", 0.2, pod="t/p0", status="bound", reason="",
+               node="tpu-host-0")
+    return rec
+
+
+def test_trace_jsonl_round_trip():
+    rec = _tiny_trace()
+    text = trace_jsonl(rec)
+    parsed = parse_trace_jsonl(text)
+    assert not parsed["truncated"]
+    assert parsed["header"]["entries"] == 3
+    assert parsed["header"]["seed"] == 3
+    assert [e["kind"] for e in parsed["entries"]] \
+        == ["fleet", "submit", "outcome"]
+    # canonical: re-serializing the parsed entries is byte-identical
+    again = "\n".join(json.dumps(canonical_entry(e), sort_keys=True)
+                      for e in parsed["entries"])
+    assert again == "\n".join(text.splitlines()[1:])
+
+
+def test_torn_tail_is_recovered_not_fatal():
+    text = trace_jsonl(_tiny_trace())
+    torn = text[:-30]               # cut the last line mid-write
+    parsed = parse_trace_jsonl(torn)
+    assert parsed["truncated"]
+    assert [e["kind"] for e in parsed["entries"]] == ["fleet", "submit"]
+    with pytest.raises(ValueError, match="corrupt at line 4"):
+        parse_trace_jsonl(torn, strict=True)
+
+
+def test_mid_stream_corruption_still_raises():
+    lines = trace_jsonl(_tiny_trace()).splitlines()
+    lines[2] = lines[2][:10]        # rot in the middle, not the tail
+    with pytest.raises(ValueError, match="corrupt at line 3"):
+        parse_trace_jsonl("\n".join(lines) + "\n")
+
+
+# -- record -> replay --------------------------------------------------------
+
+
+def test_bit_identity_under_churn():
+    """The regression gate's core promise: an unchanged build replaying
+    its own recorded churn trace reproduces it byte for byte."""
+    events = churn_events(40, seed=3)
+    rec = record_trace(events, _fleet(4), seed=11, tick_s=0.25)
+    text = trace_jsonl(rec)
+    rep = replay_trace(text, tick_s=0.25)
+    assert trace_jsonl(rep) == text
+    diff = decision_diff(rec.entries(), rep.entries())
+    assert diff["bit_identical"] and diff["identical"]
+    assert diff["pods"]["recorded"] == 40
+    assert "byte for byte" in render_diff(diff)
+
+
+def test_planted_perturbation_yields_readable_diff():
+    """A candidate build with a nudged scorer must show up: non-empty
+    diff, pods named with their old -> new nodes, flight trigger."""
+    class Nudged(SchedulerEngine):
+        def score(self, pod, node):
+            s = super().score(pod, node)
+            return s + 50.0 if node.endswith("-0") else s
+
+    events = churn_events(40, seed=3)
+    rec = record_trace(events, _fleet(4), seed=11, tick_s=0.25)
+    rep = replay_trace(trace_jsonl(rec), tick_s=0.25,
+                       engine_factory=lambda clk: Nudged(clock=clk))
+    diff = decision_diff(rec.entries(), rep.entries())
+    assert not diff["bit_identical"] and not diff["identical"]
+    assert diff["moved"], "nudged scorer must move at least one pod"
+    text = render_diff(diff)
+    m = diff["moved"][0]
+    assert m["pod"] in text
+    assert f"{m['recorded_node']} -> {m['replayed_node']}" in text
+    # the black-box hook fires and attaches both traces
+    from kubeshare_tpu.obs.flight import FlightRecorder
+    fr = FlightRecorder(clock=lambda: 0.0)
+    dump = trigger_on_diff(diff, rec.entries(), rep.entries(), flight=fr)
+    assert dump is not None
+    assert dump["reason"] == "replay-diff"
+    assert len(dump["recorded_trace"]) == len(rec.entries())
+
+
+def test_replay_refuses_traces_without_fleet_entry():
+    rec = DecisionRecorder(capacity=8)
+    rec.record("submit", 0.0, pod="t/p", labels={}, uid="")
+    with pytest.raises(ValueError, match="no fleet entry"):
+        replay_trace(trace_jsonl(rec))
+
+
+# -- service surface ---------------------------------------------------------
+
+
+def _make_service():
+    eng = SchedulerEngine()
+    reg = TelemetryRegistry()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=2, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in by_host.items():
+        reg.put_capacity(host, [c.to_labels() for c in chips])
+    svc = SchedulerService(eng, reg, replay=False)
+    svc.serve()
+    return svc
+
+
+def test_get_decisions_via_service_client():
+    svc = _make_service()
+    try:
+        svc.dispatcher.submit("unit", "p0",
+                              {"kubeshare/tpu-request": "1"})
+        svc.dispatcher.step(now=1.0)
+        body = ServiceClient(f"http://127.0.0.1:{svc.port}").decisions()
+        assert body["attached"] is True
+        assert body["seq"] >= 2             # fleet snapshot + submit + ...
+        assert "submit" in body["kinds"]
+        assert any(e["kind"] == "submit" and e["pod"] == "unit/p0"
+                   for e in body["recent"])
+        # every served entry is canonical (rounded t, fingerprinted)
+        sub = next(e for e in body["recent"] if e["kind"] == "submit")
+        assert sub["fp"] == fingerprint_labels(sub["labels"])
+    finally:
+        svc.close()
+
+
+def test_doctor_decisions_probe_against_live_service():
+    from kubeshare_tpu.doctor import check_decisions
+    svc = _make_service()
+    try:
+        assert check_decisions(f"127.0.0.1:{svc.port}", 5.0) is True
+    finally:
+        svc.close()
+
+
+# -- explicit-now lint -------------------------------------------------------
+
+#: decision-path modules where every wall-clock / entropy read must be
+#: either injected (explicit now, clock=) or marked as metric-only
+_AUDITED = [
+    "kubeshare_tpu/scheduler/dispatcher.py",
+    "kubeshare_tpu/scheduler/engine.py",
+    "kubeshare_tpu/scheduler/healthwatch.py",
+    "kubeshare_tpu/preempt/policy.py",
+    "kubeshare_tpu/autopilot/controller.py",
+    "kubeshare_tpu/autopilot/planner.py",
+]
+_FORBIDDEN = re.compile(
+    r"time\.time\(\)|time\.perf_counter\(\)|uuid4|new_trace_id\(|"
+    r"\brandom\.(random|uniform|choice|randint|shuffle)\(")
+_MARKERS = ("# wall-clock: metric-only", "# entropy: recorded")
+
+
+def test_decision_path_clock_and_entropy_reads_are_marked():
+    """Lint: replay determinism depends on the decision path never
+    reading ambient time or entropy. Any such call must carry an audit
+    marker declaring it metric-only (never feeds a decision) or
+    recorder-routed (recorded, so replay reproduces it)."""
+    offenders = []
+    for rel in _AUDITED:
+        for i, line in enumerate(
+                (REPO / rel).read_text().splitlines(), 1):
+            if _FORBIDDEN.search(line) \
+                    and not any(m in line for m in _MARKERS):
+                offenders.append(f"{rel}:{i}: {line.strip()}")
+    assert not offenders, (
+        "unmarked wall-clock/entropy reads on the decision path "
+        "(mark '# wall-clock: metric-only' or route through "
+        "DecisionRecorder and mark '# entropy: recorded'):\n"
+        + "\n".join(offenders))

@@ -55,8 +55,9 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 1
     # registry + fleetquery + scheduler + autopilot + serving + slo +
-    # invariants + gangs + ledger + preempt + prof + leases all refuse
-    assert out.count("fail") == 12
+    # invariants + gangs + ledger + preempt + prof + decisions + leases
+    # all refuse
+    assert out.count("fail") == 13
 
 
 def test_doctor_cli_subprocess():
@@ -123,8 +124,9 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 1, out
     # registry + fleetquery + scheduler + autopilot + serving + slo +
-    # invariants + gangs + ledger + preempt + prof + leases all refuse
-    assert out.count("fail") == 12, out
+    # invariants + gangs + ledger + preempt + prof + decisions + leases
+    # all refuse
+    assert out.count("fail") == 13, out
 
 
 def test_doctor_serving_probe_skip_then_ok(capsys, monkeypatch):

@@ -449,3 +449,58 @@ def test_cli_serving_unreachable_scheduler_degrades(capsys):
         assert "scheduler unreachable" in captured.err
     finally:
         srv.shutdown()
+
+
+def test_cli_replay_diff_on_trace_pair_and_saved_report(tmp_path, capsys):
+    """``--replay-diff`` renders a decision diff offline: given two
+    trace files it diffs them on the spot (exit 1 on differences, pods
+    named with old -> new nodes); given a saved ``decision_diff`` JSON
+    report it just renders; identical traces exit 0."""
+    from kubeshare_tpu.obs.decisions import trace_jsonl
+    from kubeshare_tpu.replay import (decision_diff, record_trace,
+                                      replay_trace)
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.sim.simulator import churn_events
+
+    class Nudged(SchedulerEngine):
+        def score(self, pod, node):
+            s = super().score(pod, node)
+            return s + 50.0 if node.endswith("-0") else s
+
+    by_host: dict = {}
+    for c in FakeTopology(hosts=4, mesh=(2, 2)).chips():
+        by_host.setdefault(c.host, []).append(c.to_labels())
+    rec = record_trace(churn_events(30, seed=3), by_host, seed=11,
+                       tick_s=0.25)
+    rep = replay_trace(trace_jsonl(rec), tick_s=0.25,
+                       engine_factory=lambda clk: Nudged(clock=clk))
+    rec_f = tmp_path / "recorded.jsonl"
+    rep_f = tmp_path / "replayed.jsonl"
+    rec_f.write_text(trace_jsonl(rec))
+    rep_f.write_text(trace_jsonl(rep))
+
+    # trace pair: non-empty diff, exit 1, human-readable moves
+    assert topcli.main(["--replay-diff", str(rec_f),
+                        "--against", str(rep_f)]) == 1
+    out = capsys.readouterr().out
+    assert "decision replay diff" in out and "moved" in out
+    assert " -> " in out
+
+    # same trace on both sides: bit-identical, exit 0
+    assert topcli.main(["--replay-diff", str(rec_f),
+                        "--against", str(rec_f)]) == 0
+    assert "bit-identical" in capsys.readouterr().out
+
+    # a saved diff report renders without --against; --json round-trips
+    report = tmp_path / "diff.json"
+    report.write_text(json.dumps(
+        decision_diff(rec.entries(), rep.entries())))
+    assert topcli.main(["--replay-diff", str(report), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["moved"] and not doc["identical"]
+
+    # usage errors are loud exit 2: missing file, trace without --against
+    assert topcli.main(["--replay-diff", str(tmp_path / "nope")]) == 2
+    assert "--replay-diff" in capsys.readouterr().err
+    assert topcli.main(["--replay-diff", str(rec_f)]) == 2
+    assert "--against" in capsys.readouterr().err
