@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay bench-shard image clean obs-check
 
 all: native
 
@@ -166,6 +166,15 @@ bench-profile:
 bench-replay:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_replay.py --check \
 		--baseline bench_replay.json --write bench_replay.json
+
+# Sharded-dispatch bench (doc/sharding.md): the 1k-node / 100k-pod
+# churn stream driven closed-loop through 1/2/4/8 cell-keyed shards;
+# --check gates the >=3x 4-shard throughput bar, p99-placement-no-
+# worse, flat per-shard lock wait, and the shard-equivalence replay
+# gate (plus 1-shard bit-identity), then refreshes bench_shard.json.
+bench-shard:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_shard.py --check \
+		--baseline bench_shard.json --write bench_shard.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -54,13 +54,16 @@ def violation(invariant: str, detail: str, **ctx) -> dict:
 # -- engine: bookings, cells, gangs -------------------------------------
 
 
-def check_engine(engine, in_flight=()) -> list[dict]:
+def check_engine(engine, in_flight=(), *, gangs: bool = True) -> list[dict]:
     """No chip double-booked; cell accounting consistent; gangs atomic.
 
     Caller must hold the dispatcher lock (or otherwise own the engine)
     so the snapshot is not torn mid-reservation.  ``in_flight`` is the
     set of pod keys still pending/parked — a gang with a member there
-    is mid-bind, not torn.
+    is mid-bind, not torn.  ``gangs=False`` skips the per-engine gang
+    check: a shard engine only sees its slice of a cross-shard gang, so
+    the sharded checker (:func:`check_cross_shard`) runs the atomicity
+    check over the union instead.
     """
     out: list[dict] = []
     booked_c: dict[str, float] = {}
@@ -93,7 +96,8 @@ def check_engine(engine, in_flight=()) -> list[dict]:
                 "booking-consistency",
                 f"chip {chip_id}: cell.free_memory={cell.free_memory} "
                 f"but full-booked={cell.full_memory - mem}", chip=chip_id))
-    out.extend(check_gang_atomicity(engine, in_flight))
+    if gangs:
+        out.extend(check_gang_atomicity(engine, in_flight))
     return out
 
 
@@ -115,6 +119,59 @@ def check_gang_atomicity(engine, in_flight=()) -> list[dict]:
                 "gang-atomicity",
                 f"gang {gkey}: {len(bound)}/{headcount} members bound "
                 f"(must be 0 or all)", gang=gkey))
+    return out
+
+
+def check_cross_shard(engines, in_flight=()) -> list[dict]:
+    """The sharded plane's invariants (doc/sharding.md), on top of every
+    shard's own :func:`check_engine`:
+
+    - **cross-shard-pod-ownership** — exactly one shard engine holds
+      each pod key (spillover/re-home moves the record, never copies
+      it) and a pod's bookings land only on chips its owning engine
+      knows;
+    - **cross-shard-gang-atomicity** — a gang whose members live on
+      several shards is still bound all-or-nothing ACROSS them (each
+      per-engine check only sees its own slice, so a torn cross-shard
+      commit is invisible to it).
+
+    Caller must hold ALL shard locks (``ShardedDispatcher.lock`` — the
+    ascending total order) so no trial-book is mid-flight across the
+    snapshot.
+    """
+    out: list[dict] = []
+    owner: dict[str, int] = {}
+    groups: dict[str, list] = {}
+    for idx, eng in enumerate(engines):
+        out.extend(check_engine(eng, in_flight, gangs=False))
+        chips = set(eng.leaf_cells)
+        for key, pod in eng.pod_status.items():
+            if key in owner:
+                out.append(violation(
+                    "cross-shard-pod-ownership",
+                    f"pod {key} registered on shard {owner[key]} AND "
+                    f"shard {idx}", pod=key))
+            else:
+                owner[key] = idx
+            for chip_id, _c, _m in getattr(pod, "bookings", ()):
+                if chip_id not in chips:
+                    out.append(violation(
+                        "cross-shard-pod-ownership",
+                        f"pod {key} on shard {idx} books chip "
+                        f"{chip_id} outside that shard's subtree",
+                        pod=key, chip=chip_id))
+            if pod.group_name:
+                groups.setdefault(pod.group_key, []).append(pod)
+    for gkey, members in groups.items():
+        if any(p.key in in_flight for p in members):
+            continue
+        bound = [p for p in members if p.node_name]
+        headcount = members[0].headcount or len(members)
+        if bound and len(bound) != headcount:
+            out.append(violation(
+                "cross-shard-gang-atomicity",
+                f"gang {gkey}: {len(bound)}/{headcount} members bound "
+                f"across shards (must be 0 or all)", gang=gkey))
     return out
 
 

@@ -82,9 +82,11 @@ class ChaosRunner:
     """One scenario run over a real in-process control plane."""
 
     def __init__(self, seed: int = 0, workdir: str | None = None,
-                 hosts: int = 2, mesh: tuple = (2, 2)):
+                 hosts: int = 2, mesh: tuple = (2, 2),
+                 shards: int = 1, shard_route: str = "cell"):
         from ..scheduler import SchedulerEngine
         from ..scheduler.dispatcher import Dispatcher
+        from ..scheduler.shard import make_dispatcher
         from ..serving.batcher import ContinuousBatcher
         from ..serving.frontdoor import FrontDoor
         from ..telemetry.registry import TelemetryRegistry
@@ -101,16 +103,27 @@ class ChaosRunner:
         self.registry = TelemetryRegistry(journal=self.registry_journal,
                                           clock=self._clock)
         self._partition_until = -1.0
-        self.engine = SchedulerEngine(clock=self._clock)
+        self.shards = max(1, int(shards))
         by_host: dict = {}
         for chip in FakeTopology(hosts=hosts, mesh=mesh).chips():
             by_host.setdefault(chip.host, []).append(chip)
         self.nodes = sorted(by_host)
-        for host, chips in sorted(by_host.items()):
-            self.engine.add_node(host, chips)
-        self.disp = Dispatcher(self.engine,
-                               registry=_PartitionedRegistry(self),
-                               clock=self._clock)
+        if self.shards > 1:
+            # the sharded plane under the same nemesis: per-subtree
+            # engines behind the fleet façade, cell routing by default
+            # so spillover + the cross-shard gang protocol get faulted
+            self.disp = make_dispatcher(
+                {h: list(c) for h, c in sorted(by_host.items())},
+                shards=self.shards, route=shard_route,
+                registry=_PartitionedRegistry(self), clock=self._clock)
+            self.engine = self.disp.engine
+        else:
+            self.engine = SchedulerEngine(clock=self._clock)
+            for host, chips in sorted(by_host.items()):
+                self.engine.add_node(host, chips)
+            self.disp = Dispatcher(self.engine,
+                                   registry=_PartitionedRegistry(self),
+                                   clock=self._clock)
         self.fd = FrontDoor(clock=self._clock)
         self.servable = _CrashableServable(self)
         self.batcher = ContinuousBatcher(self.fd, self.servable,
@@ -204,6 +217,13 @@ class ChaosRunner:
                     at + (2 * i) * period, "node_down", act.target))
                 self._deferred.append(ChaosAction(
                     at + (2 * i + 1) * period, "node_up", act.target))
+        elif act.action == "shard_commit_fail":
+            # arm the sharded plane's mid-commit failure injection: the
+            # NEXT cross-shard gang commit dies after `at` members — a
+            # no-op on the single-lock dispatcher (no cross-shard
+            # commits exist to fail)
+            if hasattr(self.disp, "fail_commit_at"):
+                self.disp.fail_commit_at = int(p.get("at", 1))
         elif act.action == "registry_restart":
             self._restart_registry()
         elif act.action == "registry_partition":
@@ -328,7 +348,11 @@ class ChaosRunner:
         with self.disp.lock:
             in_flight = (set(self.disp._pending)
                          | set(self.disp._parked))
-            found = invariants.check_engine(self.engine, in_flight)
+            if self.shards > 1:
+                found = invariants.check_cross_shard(
+                    [sh.engine for sh in self.disp.shards], in_flight)
+            else:
+                found = invariants.check_engine(self.engine, in_flight)
         found.extend(invariants.check_token_shares(self.token_scheds))
         found.extend(invariants.check_gang_grant_atomicity(
             self.gangcoord, now=self.now, slack_s=2 * TICK_S))
@@ -431,6 +455,7 @@ class ChaosRunner:
         return {
             "scenario": scenario.name,
             "seed": self.seed,
+            "shards": self.shards,
             "converged": converged_at is not None,
             "mttr_s": round(mttr, 3) if mttr is not None else None,
             "fault_window_end_s": round(window_end, 3),
@@ -454,27 +479,31 @@ class ChaosRunner:
 
 
 def run_scenario(name: str, seed: int = 0,
-                 workdir: str | None = None) -> dict:
-    runner = ChaosRunner(seed=seed, workdir=workdir)
+                 workdir: str | None = None, shards: int = 1) -> dict:
+    runner = ChaosRunner(seed=seed, workdir=workdir, shards=shards)
     try:
         return runner.run(build(name, seed))
     finally:
         runner.close()
 
 
-def run_suite(seed: int = 0, names: list | None = None) -> dict:
-    """Run every scenario on one seed — the ``sim --chaos`` body."""
+def run_suite(seed: int = 0, names: list | None = None,
+              shards: int = 1) -> dict:
+    """Run every scenario on one seed — the ``sim --chaos`` body.
+    ``shards > 1`` runs the same nemesis against the sharded plane
+    (cell route), sampling the cross-shard invariant catalog."""
     scenarios = ([build(n, seed) for n in names] if names
                  else all_scenarios(seed))
     results = []
     for scn in scenarios:
-        runner = ChaosRunner(seed=seed)
+        runner = ChaosRunner(seed=seed, shards=shards)
         try:
             results.append(runner.run(scn))
         finally:
             runner.close()
     return {
         "seed": seed,
+        "shards": shards,
         "scenarios": results,
         "invariant_violations": sum(len(r["violations"])
                                     for r in results),
@@ -490,13 +519,14 @@ def _percentile(values: list, q: float) -> float:
     return vals[idx]
 
 
-def run_matrix(seeds: list, names: list | None = None) -> dict:
+def run_matrix(seeds: list, names: list | None = None,
+               shards: int = 1) -> dict:
     """Multi-seed aggregation — the ``bench-chaos`` body: per-scenario
     MTTR p50/p99 across seeds plus the zero-violation gate."""
     per_scenario: dict[str, dict] = {}
     total_violations = 0
     for seed in seeds:
-        suite = run_suite(seed, names)
+        suite = run_suite(seed, names, shards=shards)
         total_violations += suite["invariant_violations"]
         for res in suite["scenarios"]:
             agg = per_scenario.setdefault(
@@ -517,6 +547,7 @@ def run_matrix(seeds: list, names: list | None = None) -> dict:
             runs=len(seeds))
     return {
         "seeds": list(seeds),
+        "shards": shards,
         "scenarios": scenarios,
         "invariant_violations": total_violations,
         "converged": all(s["converged"] for s in scenarios.values()),

@@ -32,6 +32,10 @@ Action vocabulary (executed by ``orchestrator.ChaosRunner``):
 ``park`` / ``resume`` freeze a serving tenant into a manifest / replay it
 ``servable_crash``    the shared servable raises for the window (params:
                       duration_s) — riders must fail loudly, never hang
+``shard_commit_fail`` arm the sharded plane's mid-commit failure: the
+                      next cross-shard gang commit dies after ``at``
+                      members, exercising trial-book rollback (no-op on
+                      the single-lock dispatcher)
 """
 
 from __future__ import annotations
@@ -260,6 +264,31 @@ def preemption_vs_migration(seed: int) -> Scenario:
         ])
 
 
+def cross_shard_gang_commit_fail(seed: int) -> Scenario:
+    """A gang too wide for any single shard subtree goes through the
+    optimistic cross-shard trial-book→commit — and the commit is shot
+    mid-flight (``shard_commit_fail``).  The rollback must leave every
+    shard whole (cross-shard gang atomicity), the retry must land the
+    gang, and late riders must still spill onto the leftover capacity.
+    On a single-lock run the injection is a no-op and the gang binds
+    directly — the scenario stays green in both planes."""
+    r = _rng("cross-shard-gang-commit-fail", seed)
+    return Scenario(
+        "cross-shard-gang-commit-fail",
+        "mid-commit shard failure during a cross-shard gang bind",
+        [
+            ChaosAction(0.0, "shard_commit_fail", params={"at": 2}),
+            # headcount 6 whole-chip members on 2 subtrees x 4 chips:
+            # no single subtree holds it -> the cross-shard protocol
+            ChaosAction(0.1, "submit_gang",
+                        params={"name": "wide-ring", "headcount": 6,
+                                "request": 1.0}),
+            ChaosAction(_j(r, 2.0), "submit",
+                        params={"count": 2, "request": 0.3,
+                                "prefix": "rider"}),
+        ])
+
+
 BUILDERS = {
     "node-crash-flap": node_crash_flap,
     "registry-restart-mid-lease": registry_restart_mid_lease,
@@ -269,6 +298,7 @@ BUILDERS = {
     "partition-during-gang-bind": partition_during_gang_bind,
     "gang-grant-vs-eviction": gang_grant_vs_eviction,
     "preemption-vs-migration": preemption_vs_migration,
+    "cross-shard-gang-commit-fail": cross_shard_gang_commit_fail,
 }
 
 

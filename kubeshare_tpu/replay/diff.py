@@ -73,21 +73,57 @@ def phase_totals(prof_state: dict) -> Dict[str, float]:
             for k, v in (prof_state or {}).get("phases", {}).items()}
 
 
+def _spec_classes(entries: List[dict]) -> Dict[str, str]:
+    """Pod key -> canonical spec-class key (sorted submit labels): pods
+    with byte-identical labels are interchangeable placement-wise."""
+    specs: Dict[str, str] = {}
+    for e in entries:
+        if e.get("kind") == "submit" and isinstance(e.get("labels"), dict):
+            specs[e["pod"]] = json.dumps(e["labels"], sort_keys=True)
+    return specs
+
+
 def decision_diff(recorded: List[dict], replayed: List[dict], *,
                   tol_s: float = DELAY_TOL_S,
                   phases_recorded: Optional[dict] = None,
-                  phases_replayed: Optional[dict] = None) -> dict:
-    """Compare two decision traces; see module docstring for semantics."""
+                  phases_replayed: Optional[dict] = None,
+                  shard_equivalence: bool = False) -> dict:
+    """Compare two decision traces; see module docstring for semantics.
+
+    ``shard_equivalence=True`` relaxes the comparison to *outcome
+    equivalence classes* (doc/sharding.md): a sharded plane drains
+    shards' queues concurrently, so entry order, bind timestamps, rng
+    interleaving — and which of two SPEC-IDENTICAL pods got which of
+    two nodes — legitimately differ while the schedule stays the same.
+    What must still match: the multiset of nodes each spec class bound
+    to (a *real* move shifts a class's node multiset and is flagged),
+    and every denial's terminal status. ``delayed``/``rng_divergence``
+    are still reported but do not break ``identical`` in this mode."""
     rec_out = _outcome_index(recorded)
     rep_out = _outcome_index(replayed)
     moved, denied, delayed = [], [], []
+    class_rec: Dict[str, Dict[str, int]] = {}
+    class_rep: Dict[str, Dict[str, int]] = {}
+    class_pods: Dict[str, list] = {}
+    specs = _spec_classes(recorded)
+    specs.update({k: v for k, v in _spec_classes(replayed).items()
+                  if k not in specs})
     for pod in sorted(set(rec_out) & set(rep_out)):
         a, b = rec_out[pod], rep_out[pod]
         if a["bound"] is not None and b["bound"] is not None:
             ab, bb = a["bound"], b["bound"]
             if ab.get("node") != bb.get("node"):
-                moved.append({"pod": pod, "recorded_node": ab.get("node"),
-                              "replayed_node": bb.get("node")})
+                if shard_equivalence:
+                    cls = specs.get(pod, pod)
+                    for index, e in ((class_rec, ab), (class_rep, bb)):
+                        nodes = index.setdefault(cls, {})
+                        node = e.get("node")
+                        nodes[node] = nodes.get(node, 0) + 1
+                    class_pods.setdefault(cls, []).append(pod)
+                else:
+                    moved.append({"pod": pod,
+                                  "recorded_node": ab.get("node"),
+                                  "replayed_node": bb.get("node")})
             elif abs(bb["t"] - ab["t"]) > tol_s:
                 delayed.append({"pod": pod,
                                 "recorded_t": round(ab["t"], 6),
@@ -98,6 +134,21 @@ def decision_diff(recorded: List[dict], replayed: List[dict], *,
             if sa["status"] != sb["status"]:
                 denied.append({"pod": pod, "recorded": sa,
                                "replayed": sb})
+    if shard_equivalence:
+        # a class whose node multiset is unchanged was a pure swap among
+        # interchangeable pods — equivalent, not moved
+        for cls in sorted(class_rec):
+            if class_rec[cls] != class_rep.get(cls, {}):
+                for pod in class_pods[cls]:
+                    moved.append({
+                        "pod": pod,
+                        "recorded_node": rec_out[pod]["bound"].get("node"),
+                        "replayed_node": rep_out[pod]["bound"].get("node"),
+                        "class_recorded": dict(sorted(class_rec[cls]
+                                                      .items())),
+                        "class_replayed": dict(sorted(class_rep
+                                                      .get(cls, {})
+                                                      .items()))})
     missing = sorted(set(rec_out) - set(rep_out))
     extra = sorted(set(rep_out) - set(rec_out))
 
@@ -133,12 +184,18 @@ def decision_diff(recorded: List[dict], replayed: List[dict], *,
                              "replayed_s": round(rb, 6),
                              "delta_s": round(rb - ra, 6)}
 
-    identical = not (moved or denied or delayed or missing or extra
-                     or rng_div)
+    if shard_equivalence:
+        # timing skew and rng interleaving are inherent to concurrent
+        # shard drains; only real schedule changes break equivalence
+        identical = not (moved or denied or missing or extra)
+    else:
+        identical = not (moved or denied or delayed or missing or extra
+                         or rng_div)
     return {
         "bit_identical": (trace_fingerprint(recorded)
                           == trace_fingerprint(replayed)),
         "identical": identical,
+        "equivalence": "shard" if shard_equivalence else "strict",
         "moved": moved,
         "denied": denied,
         "delayed": delayed,
@@ -164,8 +221,12 @@ def render_diff(diff: dict) -> str:
                      "recorded trace byte for byte")
         return "\n".join(lines)
     if diff.get("identical"):
-        lines.append("  no behavioral differences (traces differ only "
-                     "in non-decision bytes)")
+        if diff.get("equivalence") == "shard":
+            lines.append("  shard-equivalent: same placement classes "
+                         "and denials (order/timing differences only)")
+        else:
+            lines.append("  no behavioral differences (traces differ "
+                         "only in non-decision bytes)")
         return "\n".join(lines)
     for m in diff["moved"]:
         lines.append("  moved   %-28s %s -> %s"

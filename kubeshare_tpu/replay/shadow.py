@@ -46,22 +46,27 @@ def build_cluster(clock, fleet_nodes: dict, config: Optional[dict] = None,
     """A fresh engine + dispatcher on *clock* from a trace's ``fleet``
     entry (``{node: [chip labels]}``). ``engine_factory(clock)`` swaps
     in a candidate engine build (the perturbation seam the bench
-    uses); ``config`` re-applies the recorded dispatcher knobs."""
-    from ..scheduler.dispatcher import Dispatcher
-    from ..scheduler.engine import SchedulerEngine
+    uses); ``config`` re-applies the recorded dispatcher knobs, plus
+    the sharding ones: ``shards`` (> 1 builds a
+    :class:`~..scheduler.shard.ShardedDispatcher`) and ``shard_route``
+    (``"score"``/``"cell"``). The fleet lands via ONE ``set_fleet``
+    (one topology rebuild, not one per node — identical end state,
+    and the difference between seconds and minutes at 1k nodes)."""
+    from ..scheduler.shard import make_dispatcher
     from ..topology.chip import ChipInfo
 
     cfg = dict(config or {})
-    eng = (engine_factory(clock) if engine_factory is not None
-           else SchedulerEngine(clock=clock))
-    for node, labels in sorted(fleet_nodes.items()):
-        eng.add_node(node, [ChipInfo.from_labels(lb) for lb in labels])
-    disp = Dispatcher(
-        eng, registry=None, clock=clock,
+    fleet = {node: [ChipInfo.from_labels(lb) for lb in labels]
+             for node, labels in sorted(fleet_nodes.items())}
+    disp = make_dispatcher(
+        fleet, shards=int(cfg.get("shards", 1)),
+        route=cfg.get("shard_route", "score"),
+        clock=clock,
         gc_period_s=float(cfg.get("gc_period_s", 30.0)),
         retry_backoff_s=float(cfg.get("retry_backoff_s", 1.0)),
-        max_pending=cfg.get("max_pending"))
-    return eng, disp
+        max_pending=cfg.get("max_pending"),
+        engine_factory=engine_factory)
+    return disp.engine, disp
 
 
 def _apply_input(disp, entry: dict, now: float) -> None:
@@ -152,11 +157,15 @@ def record_trace(events: List[dict], fleet_nodes: dict, *, seed: int = 0,
 
 def replay_trace(trace, *, engine_factory: Optional[Callable] = None,
                  tick_s: Optional[float] = None,
-                 capacity: int = 65536) -> DecisionRecorder:
+                 capacity: int = 65536,
+                 config: Optional[dict] = None) -> DecisionRecorder:
     """Candidate run: feed a recorded trace (a :func:`~..obs.decisions.
     parse_trace_jsonl` dict, raw JSONL text, or a ground-truth
     :class:`DecisionRecorder`) through a candidate build in virtual
-    time; returns the candidate's recorder for diffing."""
+    time; returns the candidate's recorder for diffing. *config* keys
+    override the recorded dispatcher config — ``{"shards": 4}`` replays
+    a single-lock trace through a sharded build (the shard-equivalence
+    gate, doc/sharding.md)."""
     from ..obs.decisions import trace_jsonl
 
     if isinstance(trace, DecisionRecorder):
@@ -171,8 +180,10 @@ def replay_trace(trace, *, engine_factory: Optional[Callable] = None,
         raise ValueError("decision trace has no fleet entry; only "
                          "harness-recorded traces are replayable")
     vclock = VirtualClock()
+    cfg = dict(meta.get("config") or {})
+    cfg.update(config or {})
     eng, disp = build_cluster(vclock, fleet.get("nodes", {}),
-                              meta.get("config"), engine_factory)
+                              cfg, engine_factory)
     rec = DecisionRecorder(capacity=capacity, clock=vclock,
                            seed=int(header.get("seed", 0)))
     rec.meta.update(meta)

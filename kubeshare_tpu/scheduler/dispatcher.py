@@ -131,9 +131,14 @@ class Dispatcher:
                  gc_period_s: float = GC_PERIOD_S,
                  retry_backoff_s: float = RETRY_BACKOFF_S,
                  clock=time.monotonic, sync=None,
-                 max_pending: int | None = None):
+                 max_pending: int | None = None,
+                 name: str = "dispatcher"):
         self.engine = engine
         self.registry = registry
+        #: lock/profiler family name — per-shard dispatchers get
+        #: "dispatcher-shard<i>" so kubeshare_lock_* metrics and phase
+        #: profiles stay attributable per shard (doc/sharding.md)
+        self.name = name
         self.gc_period_s = gc_period_s
         self.retry_backoff_s = retry_backoff_s
         #: bounded admission: submits beyond this many pending pods are
@@ -148,11 +153,11 @@ class Dispatcher:
         # (doc/observability.md, "Locks, phases, and profiles"). Always
         # on the wall clock — the injectable scheduler clock may be
         # frozen, which would zero every hold.
-        self._cond = obs_prof.TrackedCondition("dispatcher")
+        self._cond = obs_prof.TrackedCondition(name)
         #: per-phase attribution of the under-lock step time; the
         #: doctor's /prof probe and bench-profile assert the phases
         #: cover >= 95% of the measured span
-        self.prof_phases = obs_prof.PhaseProfiler("dispatcher")
+        self.prof_phases = obs_prof.PhaseProfiler(name)
         self._pending: dict[str, PodRequest] = {}
         self._retry_at: dict[str, float] = {}
         self._parked: dict[str, _Parked] = {}
@@ -181,8 +186,30 @@ class Dispatcher:
         #: terminal outcome, preemption plan, eviction and move lands
         #: in its ring as a replayable trace (doc/replay.md)
         self.decisions = None
+        #: set by ShardedDispatcher: this dispatcher is shard N of a
+        #: sharded plane (None = standalone, the single-lock scheduler)
+        self.shard_id: int | None = None
+        #: optional per-shard event queue (scheduler.shard.ShardEvents):
+        #: when set, scheduling outcomes/evictions/unschedulables are
+        #: published so cross-shard consumers (healthwatch, SLO,
+        #: autopilot triggers, spillover, gang rebalance) run
+        #: event-driven instead of polling inside _step_inner
+        self.events = None
+        #: when False the attached SLO evaluator is NOT evaluated inside
+        #: _step_inner — a sharded plane evaluates it once per pump off
+        #: the shard locks (outcome recording via _resolve still runs)
+        self.slo_inline = True
         self.shed_total = 0
         self._next_gc = 0.0
+        #: engine.alloc_gen at the last recorded capacity view — the
+        #: view is a pure function of (leaf cells, node health), both of
+        #: which bump alloc_gen, so unchanged gen ⇒ unchanged view and
+        #: the O(chips) rebuild can be skipped (1k-node replay cost)
+        self._view_gen: int | None = None
+        #: False on shards sharing one recorder: record_view's delta
+        #: encoding assumes full-fleet views, so the sharded plane
+        #: records ONE merged view itself (scheduler.shard)
+        self.record_views = True
         self._stop = False
         self._thread: threading.Thread | None = None
 
@@ -219,16 +246,22 @@ class Dispatcher:
         self.gangcoord = coord
         return self
 
-    def attach_decisions(self, rec) -> "Dispatcher":
+    def attach_decisions(self, rec, record_fleet: bool = True
+                         ) -> "Dispatcher":
         """Wire a :class:`~..obs.decisions.DecisionRecorder`: the
         decision path (submit, resolve, preempt, evict, move) records a
         replayable trace (doc/replay.md). Recording opens with a
         ``fleet`` entry — the engine's current chip inventory, what the
         shadow replayer rebuilds the candidate cluster from — and the
         engine's trace-id entropy is routed through the recorder so
-        replay draws the same ids."""
+        replay draws the same ids. ``record_fleet=False`` skips the
+        fleet entry: a sharded plane shares ONE recorder across shards
+        and records a single merged fleet entry itself
+        (doc/sharding.md)."""
         self.decisions = rec
         self.engine.decisions = rec
+        if not record_fleet:
+            return self
         with self._cond:
             nodes = {}
             for node, models in sorted(self.engine.chips_by_node.items()):
@@ -334,50 +367,71 @@ class Dispatcher:
         :class:`Overloaded` when the bounded admission queue refuses new
         load. Returns the pod key (poll with :meth:`status` /
         :meth:`outcome`)."""
+        with self._cond:
+            return self._submit_locked(namespace, name, labels, uid)
+
+    def submit_many(self, items) -> list:
+        """Batched admission: submit a burst under ONE lock acquisition
+        instead of one per pod (doc/sharding.md). *items* is an iterable
+        of ``(namespace, name, labels[, uid])``; returns per-item
+        results — the pod key, or the :class:`Overloaded`/``LabelError``
+        exception the item raised (the rest of the batch still lands)."""
+        out = []
+        with self._cond:
+            for item in items:
+                ns, name, labels = item[0], item[1], item[2]
+                uid = item[3] if len(item) > 3 else ""
+                try:
+                    out.append(self._submit_locked(ns, name, labels, uid))
+                except Exception as e:    # Overloaded / LabelError
+                    out.append(e)
+        return out
+
+    def _submit_locked(self, namespace: str, name: str, labels: dict,
+                       uid: str = "") -> str:
         tracer = get_tracer()
         adm_t0 = tracer.now_ms()
-        with self._cond:
-            dec = self.decisions
-            if dec is None:
+        dec = self.decisions
+        if dec is None:
+            self._check_admission(namespace, name)
+        else:
+            try:
                 self._check_admission(namespace, name)
-            else:
-                try:
-                    self._check_admission(namespace, name)
-                except Overloaded as shed:
-                    # ONE entry on the shed path (it IS the admission
-                    # hot loop, bench_replay gates its cost): the
-                    # submit input and its denial together, spec
-                    # included so replay can re-drive the shed
-                    dec.record("submit", self._clock(),
-                               pod=f"{namespace}/{name}",
-                               labels=dict(labels), uid=uid,
-                               shed=shed.reason)
-                    raise
+            except Overloaded as shed:
+                # ONE entry on the shed path (it IS the admission
+                # hot loop, bench_replay gates its cost): the
+                # submit input and its denial together, spec
+                # included so replay can re-drive the shed
                 dec.record("submit", self._clock(),
                            pod=f"{namespace}/{name}",
-                           labels=dict(labels), uid=uid)
-            pod = self.engine.submit(namespace, name, labels, uid=uid)
-            # the critical path's first segment: admission control +
-            # label parse + enqueue, under the pod's fresh trace id
-            tracer.record("admission", pod.trace_id, adm_t0,
-                          tracer.now_ms(),
-                          parent_id=(pod.trace_span.span_id
-                                     if pod.trace_span else ""),
-                          pod=pod.key)
-            parked = self._parked.get(pod.key)
-            if parked is not None:
-                if parked.pod is pod:
-                    return pod.key      # already reserved, awaiting permit
-                # new incarnation (uid change): engine.submit reclaimed the
-                # old booking, so the parked entry's binding is stale —
-                # drop it and requeue the new pod
-                del self._parked[pod.key]
-            if pod.node_name:           # already bound (resubmit of bound)
-                return pod.key
-            self._pending[pod.key] = pod
-            self._results.pop(pod.key, None)
-            self._cond.notify_all()
+                           labels=dict(labels), uid=uid,
+                           shed=shed.reason)
+                raise
+            dec.record("submit", self._clock(),
+                       pod=f"{namespace}/{name}",
+                       labels=dict(labels), uid=uid)
+        pod = self.engine.submit(namespace, name, labels, uid=uid)
+        # the critical path's first segment: admission control +
+        # label parse + enqueue, under the pod's fresh trace id
+        tracer.record("admission", pod.trace_id, adm_t0,
+                      tracer.now_ms(),
+                      parent_id=(pod.trace_span.span_id
+                                 if pod.trace_span else ""),
+                      pod=pod.key)
+        parked = self._parked.get(pod.key)
+        if parked is not None:
+            if parked.pod is pod:
+                return pod.key      # already reserved, awaiting permit
+            # new incarnation (uid change): engine.submit reclaimed the
+            # old booking, so the parked entry's binding is stale —
+            # drop it and requeue the new pod
+            del self._parked[pod.key]
+        if pod.node_name:           # already bound (resubmit of bound)
             return pod.key
+        self._pending[pod.key] = pod
+        self._results.pop(pod.key, None)
+        self._cond.notify_all()
+        return pod.key
 
     def delete(self, key: str) -> None:
         """Pod removal: reclaim + drop from every queue
@@ -465,12 +519,29 @@ class Dispatcher:
             span.close("queue-poll")
 
     def _step_inner(self, now: float, span) -> float:
+        # The three pieces are separately callable so a sharded plane
+        # (scheduler.shard) can run housekeeping per shard, drain ready
+        # pods in a global queue_less order, and reconcile afterwards —
+        # with identical sequencing to this single-lock path.
+        self._pre_pass(now, span)
+        self._drain_ready(now, span)
+        self._post_pass(now)
+        return self._next_delay(now)
+
+    def _pre_pass(self, now: float, span) -> None:
+        """Housekeeping before the scheduling pass (caller holds the
+        lock): GC, healthwatch/SLO polls (when inline), flight-recorder
+        samples, view deltas, permit-deadline expiry, pod deadlines."""
         if now >= self._next_gc:
             self.engine.groups.gc()
             self._next_gc = now + self.gc_period_s
         span.lap("queue-poll")
 
-        if self.healthwatch is not None:
+        if self.healthwatch is not None and self.healthwatch.due(now):
+            # the due-gate keeps the phase bracket honest: a poll that
+            # would no-op on its cadence must not lap time into the
+            # "healthwatch" phase (phantom coverage — doc/sharding.md,
+            # event-driven consumers run their own off-step span)
             try:
                 self.healthwatch.poll(now, self)
             except Exception:
@@ -478,7 +549,7 @@ class Dispatcher:
                 log.exception("healthwatch poll failed")
             span.lap("healthwatch")
 
-        if self.slo is not None:
+        if self.slo is not None and self.slo_inline:
             try:
                 self.slo.evaluate(now)
             except Exception:
@@ -503,8 +574,13 @@ class Dispatcher:
             # capacity/health view delta into the decision trace, and
             # the per-kind decision counts into the black box (delta
             # samples are their own rate limit: unchanged counts record
-            # nothing)
-            self.decisions.record_view(now, self._decision_view())
+            # nothing). The O(chips) view rebuild is skipped whenever
+            # alloc_gen is unchanged — the view is a pure function of
+            # state that always bumps it (1k-node replay stays <60s).
+            gen = self.engine.alloc_gen
+            if self.record_views and gen != self._view_gen:
+                self.decisions.record_view(now, self._decision_view())
+                self._view_gen = gen
             rec.sample_deltas("decision", {
                 k: float(v) for k, v in self.decisions.counts().items()})
 
@@ -531,6 +607,9 @@ class Dispatcher:
                 f"unscheduled for {now - pod.timestamp:.1f}s "
                 f"(deadline {pod.deadline_s:.1f}s)"))
 
+    def _drain_ready(self, now: float, span) -> None:
+        """Schedule every ready pod, highest queue_less first (caller
+        holds the lock)."""
         synced = False
         progressed = True
         while progressed:
@@ -552,6 +631,7 @@ class Dispatcher:
                 self._cycle(pod, now, span)
                 progressed = True
 
+    def _post_pass(self, now: float) -> None:
         # AFTER the pass (same-step binds must take effect immediately —
         # the bridge polls between steps): eviction requests complete
         # when the victim leaves the engine (its DELETED event ran
@@ -580,6 +660,8 @@ class Dispatcher:
                          "bound" if pre is not None else "gone")
                 del self._evict_requested[key]
 
+    def _next_delay(self, now: float) -> float:
+        """Seconds until the next timed event (caller holds the lock)."""
         nxt = self._next_gc
         for parked in self._parked.values():
             nxt = min(nxt, parked.deadline)
@@ -605,7 +687,12 @@ class Dispatcher:
         return best
 
     def _cycle(self, pod: PodRequest, now: float,
-               span=obs_prof._NULL_SPAN) -> None:
+               span=obs_prof._NULL_SPAN, placer=None) -> None:
+        """One scheduling cycle. ``placer(pod) -> Binding`` (when given)
+        replaces ``engine.schedule`` — the sharded plane's global score
+        router places across shard engines through this seam while every
+        other step of the cycle (publish, permit, metrics, resolve)
+        stays this exact code path (doc/sharding.md)."""
         tracer = get_tracer()
         parent = pod.trace_span.span_id if pod.trace_span else ""
         ok, msg = self.engine.pre_filter(pod)
@@ -614,7 +701,8 @@ class Dispatcher:
             span.lap("filter-score")
             return
         try:
-            binding = self.engine.schedule(pod)
+            binding = (self.engine.schedule(pod) if placer is None
+                       else placer(pod))
         except Unschedulable as e:
             preempted = self._maybe_preempt(pod, now)
             if not preempted:
@@ -996,6 +1084,9 @@ class Dispatcher:
         if self.decisions is not None:
             self.decisions.record("evict", now, node=node, reason=reason,
                                   pods=list(evicted))
+        if self.events is not None:
+            self.events.emit(self.shard_id, "evict", node, now,
+                             pods=len(evicted))
         # a node loss is a black-box trigger: dump what the system was
         # doing in the run-up (doc/observability.md, flight recorder)
         rec = default_recorder()
@@ -1010,6 +1101,9 @@ class Dispatcher:
         self._pending[pod.key] = pod
         self._retry_at[pod.key] = now + self.retry_backoff_s
         self._last_reason[pod.key] = reason
+        if self.events is not None:
+            self.events.emit(self.shard_id, "unschedulable", pod.key,
+                             now, reason=reason)
         log.debug("%s unschedulable, retrying in %.1fs: %s",
                   pod.key, self.retry_backoff_s, reason)
 
@@ -1057,6 +1151,9 @@ class Dispatcher:
                             now=self._clock())
         self._results.pop(key, None)   # re-insert at the back (LRU order)
         self._results[key] = outcome
+        if self.events is not None:
+            self.events.emit(self.shard_id, "outcome", key,
+                             self._clock(), status=outcome.status)
         self._last_reason.pop(key, None)
         self._health_evicted.pop(key, None)  # rebound (or gone): the
         # "node lost" story ends with a terminal disposition

@@ -189,6 +189,7 @@ class SchedulerEngine:
         self.mesh_shape = mesh_shape
         self._clock = clock
         self._fleet_snapshot: tuple | None = None
+        self._nodes_cache: list[str] | None = None
         #: decision recorder (set by Dispatcher.attach_decisions): when
         #: present, trace-id entropy is drawn through it so a shadow
         #: replay reproduces the recorded ids (doc/replay.md)
@@ -219,6 +220,7 @@ class SchedulerEngine:
         the same replay the crash resync performs."""
         known = node_name in self.chips_by_node
         self.alloc_gen += 1
+        self._nodes_cache = None
         self._fleet_snapshot = None   # per-node edits invalidate the
         by_model: dict[str, list[ChipInfo]] = {}  # set_fleet no-op check
         for chip in chips:
@@ -259,6 +261,7 @@ class SchedulerEngine:
         if snapshot == self._fleet_snapshot:
             return
         self._fleet_snapshot = snapshot
+        self._nodes_cache = None
         for gone in set(self.chips_by_node) - set(fleet):
             del self.chips_by_node[gone]
             self.node_health.pop(gone, None)
@@ -339,7 +342,13 @@ class SchedulerEngine:
 
     @property
     def nodes(self) -> list[str]:
-        return sorted(self.chips_by_node)
+        # cached: schedule() reads this per placement, and re-sorting
+        # 1k node names 100k times is real money at fleet scale; the
+        # only membership mutators (add_node/set_fleet) invalidate it
+        cached = self._nodes_cache
+        if cached is None:
+            cached = self._nodes_cache = sorted(self.chips_by_node)
+        return cached
 
     # -- workload intake ---------------------------------------------------
 

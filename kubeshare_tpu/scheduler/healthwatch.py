@@ -123,6 +123,13 @@ class HealthWatch:
 
     # -- the poll ----------------------------------------------------------
 
+    def due(self, now: float) -> bool:
+        """Would :meth:`poll` actually run at *now*? The dispatcher's
+        phase bracket gates on this so a cadence no-op never laps time
+        into the ``healthwatch`` phase (phantom coverage), and the
+        sharded plane's event pump uses it to skip idle cycles."""
+        return now >= self._next_poll
+
     def poll(self, now: float, dispatcher=None) -> list[str]:
         """Advance every node's state machine; returns nodes whose state
         changed. Runs under the dispatcher lock (its step calls this) —

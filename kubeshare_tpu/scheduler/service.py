@@ -75,16 +75,46 @@ log = get_logger("schedsvc")
 class SchedulerService:
     def __init__(self, engine: SchedulerEngine,
                  registry: RegistryClient | TelemetryRegistry,
-                 replay: bool = True, healthwatch=None, **dispatcher_kw):
+                 replay: bool = True, healthwatch=None,
+                 shards: int = 1, shard_route: str = "cell",
+                 **dispatcher_kw):
         """``healthwatch``: None/False = no liveness plane (pre-health
         behavior); True = a default :class:`HealthWatch` over
-        ``registry``; or pass a configured instance."""
+        ``registry``; or pass a configured instance.
+
+        ``shards > 1`` runs the sharded plane (doc/sharding.md): the
+        fleet is synced from the registry once, carved into subtree
+        shards, and served through a
+        :class:`~.shard.ShardedDispatcher` behind the same endpoints
+        (``self.engine`` becomes the merged fleet façade).  Per-shard
+        registry capacity sync is off in this mode — the subtree
+        inventory is fixed at build time."""
         self.engine = engine
         self.registry = registry
-        self.dispatcher = Dispatcher(
-            engine, registry,
-            sync=lambda: sync_engine_from_registry(engine, registry),
-            **dispatcher_kw)
+        self.shards = max(1, int(shards))
+        if self.shards > 1:
+            from .shard import build_sharded
+
+            try:
+                sync_engine_from_registry(engine, registry)
+            except Exception as e:
+                log.warning("sharded build: initial fleet sync "
+                            "failed: %s", e)
+            fleet = {}
+            for node, models in engine.chips_by_node.items():
+                chips = sorted((c for cs in models.values() for c in cs),
+                               key=lambda c: c.chip_id)
+                fleet[node] = (chips,
+                               engine.node_health.get(node, True))
+            self.dispatcher = build_sharded(
+                fleet, self.shards, route=shard_route,
+                registry=registry, **dispatcher_kw)
+            self.engine = self.dispatcher.engine
+        else:
+            self.dispatcher = Dispatcher(
+                engine, registry,
+                sync=lambda: sync_engine_from_registry(engine, registry),
+                **dispatcher_kw)
         if healthwatch is True:
             healthwatch = HealthWatch(registry)
         self.healthwatch: HealthWatch | None = healthwatch or None
@@ -510,6 +540,19 @@ def main(argv=None) -> None:
     parser.add_argument("--health", action="store_true",
                         help="enable the lease-driven health plane "
                              "(detection -> eviction -> reschedule)")
+    parser.add_argument("--shards", type=int, default=1,
+                        help="cell-keyed scheduler shards (doc/"
+                             "sharding.md): >1 partitions the fleet "
+                             "into N subtree shards with per-shard "
+                             "queues/locks behind the same endpoints "
+                             "(1 = the single-lock dispatcher)")
+    parser.add_argument("--shard-route", default="cell",
+                        choices=("cell", "score"),
+                        help="with --shards>1: 'cell' = per-subtree "
+                             "placement with spillover + cross-shard "
+                             "gangs (the throughput mode); 'score' = "
+                             "global score walk, placement-identical "
+                             "to single-lock (the migration mode)")
     parser.add_argument("--lease-ttl", type=float, default=C.LEASE_TTL_S,
                         help="heartbeat lease TTL the healthwatch assumes "
                              "for nodes that did not declare one")
@@ -573,6 +616,7 @@ def main(argv=None) -> None:
         engine, registry,
         healthwatch=(HealthWatch(registry, ttl_s=args.lease_ttl)
                      if args.health else None),
+        shards=args.shards, shard_route=args.shard_route,
         max_pending=args.max_pending or None)
     if args.autopilot:
         from ..autopilot import Autopilot, Planner, Rebalancer

@@ -824,6 +824,12 @@ def main(argv=None) -> None:
                         metavar="NAME",
                         help="with --chaos: run only NAME (repeatable; "
                              "default: every scenario)")
+    parser.add_argument("--shards", type=int, default=1, metavar="N",
+                        help="with --chaos: run the nemesis against an "
+                             "N-shard cell-route dispatcher plane "
+                             "(doc/sharding.md) with cross-shard "
+                             "invariants sampled; 1 = the single-lock "
+                             "scheduler (default)")
     parser.add_argument("--prof-report", action="store_true",
                         help="append the runtime contention profiler "
                              "snapshot (tracked locks + dispatcher "
@@ -846,9 +852,15 @@ def main(argv=None) -> None:
         from ..chaos import run_suite
 
         out = run_suite(seed=args.seed,
-                        names=args.chaos_scenario or None)
+                        names=args.chaos_scenario or None,
+                        shards=args.shards)
         print(json.dumps({"chaos": out}, sort_keys=True))
         return
+    if args.shards != 1:
+        parser.error("--shards only applies to --chaos (the virtual-"
+                     "time sim loop drives the engine directly; the "
+                     "sharded plane lives behind the Dispatcher — see "
+                     "doc/sharding.md)")
     if args.critpath:
         if args.spans_dir:
             import os

@@ -1118,7 +1118,8 @@ def _replay_diff_main(args) -> int:
             print(f"kubeshare-top: --against: {e}", file=sys.stderr)
             return 2
         diff = decision_diff(parse_trace_jsonl(text)["entries"],
-                             parse_trace_jsonl(other)["entries"])
+                             parse_trace_jsonl(other)["entries"],
+                             shard_equivalence=args.shard_equiv)
     else:
         try:
             diff = json.loads(text)
@@ -1251,6 +1252,12 @@ def main(argv=None) -> int:
                              "diff a recorded decision trace against "
                              "--against TRACE; exits 1 on a non-empty "
                              "diff (doc/replay.md)")
+    parser.add_argument("--shard-equiv", action="store_true",
+                        help="with --replay-diff/--against: compare "
+                             "outcome equivalence classes (same per-spec "
+                             "pod->node multiset, same denials) instead "
+                             "of byte order — the sharded-vs-single-lock "
+                             "gate (doc/sharding.md)")
     parser.add_argument("--against", default=None, metavar="TRACE",
                         help="candidate decision trace for --replay-diff "
                              "when FILE is itself a trace")

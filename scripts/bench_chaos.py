@@ -34,6 +34,17 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 #: the chaos-matrix seeds; >= 3 per the acceptance criteria
 SEEDS = (3, 11, 23)
 
+#: the sharded leg: the same nemesis against a 2-shard cell-route
+#: plane, on the scheduling-relevant scenarios (serving faults are
+#: orthogonal to sharding) — cross-shard invariants sampled throughout
+SHARD_COUNT = 2
+SHARD_SCENARIOS = (
+    "node-crash-flap",
+    "partition-during-gang-bind",
+    "gang-grant-vs-eviction",
+    "cross-shard-gang-commit-fail",
+)
+
 #: every scenario must reconverge within this many virtual seconds of
 #: its fault window across all seeds (a loose roof — the per-scenario
 #: bounds in scenarios.py are tighter and checked during the run)
@@ -48,10 +59,15 @@ def _metric_keys(out: dict) -> list:
         keys.append(f"{name}.mttr_p50_s")
         keys.append(f"{name}.mttr_p99_s")
     keys.append("invariant_violations")
+    for name in sorted(out.get("sharded", {}).get("scenarios", {})):
+        keys.append(f"sharded:{name}.mttr_p99_s")
+    keys.append("sharded:invariant_violations")
     return keys
 
 
 def _lookup(out: dict, key: str):
+    if key.startswith("sharded:"):
+        out, key = out.get("sharded", {}), key[len("sharded:"):]
     if "." in key:
         name, metric = key.split(".", 1)
         return out.get("scenarios", {}).get(name, {}).get(metric)
@@ -63,6 +79,8 @@ def run_bench() -> dict:
 
     logging.disable(logging.CRITICAL)    # the runs are deliberately noisy
     out = run_matrix(list(SEEDS))
+    out["sharded"] = run_matrix(list(SEEDS), list(SHARD_SCENARIOS),
+                                shards=SHARD_COUNT)
     logging.disable(logging.NOTSET)
     return out
 
@@ -81,6 +99,19 @@ def check(out: dict) -> int:
                      scn["mttr_p99_s"] <= MTTR_ROOF_S,
                      f"recovery must land inside {MTTR_ROOF_S:g} virtual "
                      f"seconds"))
+    sharded = out.get("sharded", {})
+    bars.append(("sharded:invariant_violations",
+                 sharded.get("invariant_violations") == 0,
+                 "no cross-shard invariant may be violated under the "
+                 "sharded plane"))
+    bars.append(("sharded:converged", sharded.get("converged", False),
+                 "every sharded scenario must reconverge within its "
+                 "bound"))
+    for name, scn in sorted(sharded.get("scenarios", {}).items()):
+        bars.append((f"sharded:{name}.mttr_p99_s",
+                     scn["mttr_p99_s"] <= MTTR_ROOF_S,
+                     f"sharded recovery must land inside "
+                     f"{MTTR_ROOF_S:g} virtual seconds"))
     failed = [f"{name}: {why} (got {_lookup(out, name)})"
               for name, ok, why in bars if not ok]
     for line in failed:

@@ -19,6 +19,15 @@ promise, not a hope. Three legs, each a bar ``--check`` enforces:
   ``Dispatcher._step_inner`` must account for >= 95% of measured
   under-lock span time (the same bar the doctor's ``/prof`` probe
   checks on a live scheduler).
+- **HealthWatch poll accounting**: a lease watch on a slow poll
+  cadence attached to a fast-stepping dispatcher. Before the due-gate
+  fix, every step closed a ``healthwatch`` lap even when the poll
+  no-oped on its cadence, attributing phantom time to a phase that did
+  no work; now the bracket only closes when :meth:`HealthWatch.due`
+  says the poll actually ran. Bars: zero phantom laps (phase lap count
+  == polls that ran), the cadence actually idles most steps (or the
+  phantom check is vacuous), and coverage holds >= 95% with the watch
+  attached.
 - **Accuracy under churn**: the sim's ``--churn`` workload
   (``synthesize_churn`` / ``churn_labels``) driven through a real
   ``Dispatcher`` by contending submitter threads against a stepper
@@ -223,6 +232,45 @@ def run_phases() -> dict:
     return state
 
 
+def run_healthwatch() -> dict:
+    """The poll-accounting leg: lap counts in the ``healthwatch`` phase
+    must equal the polls that actually ran (the due-gate), never the
+    step count, and coverage must hold the bar with the watch wired."""
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.scheduler.healthwatch import HealthWatch
+
+    clock = _Clock()
+    eng, reg, disp = _make_cluster(clock)
+    for epoch, host in enumerate(sorted(eng.chips_by_node), start=1):
+        reg.put_lease(host, epoch, ttl_s=10.0)
+    hw = HealthWatch(reg, ttl_s=10.0, poll_period_s=5.0, clock=clock)
+    disp.attach_healthwatch(hw)
+    polls = [0]
+    real_poll = hw.poll
+
+    def counting_poll(now, dispatcher=None):
+        polls[0] += 1
+        return real_poll(now, dispatcher)
+
+    hw.poll = counting_poll
+    for i in range(32):                     # keep the other phases warm
+        disp.submit(f"t{i % 4}", f"hw{i}",
+                    {C.POD_TPU_REQUEST: "0.25", C.POD_TPU_LIMIT: "1"})
+    steps = 400
+    for _ in range(steps):                  # 0.1s ticks vs a 5s cadence
+        clock.t += 0.1
+        disp.step(now=clock.t)
+    laps = disp.prof_phases.phase_counts.get("healthwatch", 0)
+    return {"steps": steps,
+            "polls_run": polls[0],
+            "healthwatch_laps": laps,
+            "phantom_laps": laps - polls[0],
+            "healthwatch_phase_s":
+                round(disp.prof_phases.phase_totals.get("healthwatch",
+                                                        0.0), 6),
+            "coverage": round(disp.prof_phases.coverage(), 4)}
+
+
 def run_churn() -> dict:
     """sim --churn load through a real Dispatcher with contending
     threads; every outermost lock entry carries a direct perf_counter
@@ -296,6 +344,7 @@ def run_bench() -> dict:
                      "wait accuracy under churn",
             "overhead": run_overhead(),
             "phases": run_phases(),
+            "healthwatch": run_healthwatch(),
             "churn": run_churn()}
 
 
@@ -310,6 +359,19 @@ def check(out: dict) -> int:
          out["phases"]["coverage"] >= COVERAGE_BAR,
          f"phase attribution must cover >= {COVERAGE_BAR:.0%} of "
          "measured under-lock span time"),
+        ("healthwatch.phantom_laps",
+         out["healthwatch"]["phantom_laps"] == 0,
+         "the healthwatch phase must only be lapped by polls that "
+         "actually ran (no phantom coverage from cadence no-ops)"),
+        ("healthwatch.polls_run",
+         0 < out["healthwatch"]["polls_run"]
+         <= out["healthwatch"]["steps"] // 10,
+         "the poll cadence must actually idle most steps, or the "
+         "phantom-lap check is vacuous"),
+        ("healthwatch.coverage",
+         out["healthwatch"]["coverage"] >= COVERAGE_BAR,
+         f"phase coverage must hold >= {COVERAGE_BAR:.0%} with a "
+         "healthwatch attached"),
         ("churn.top_lock", out["churn"]["top_lock"] == "dispatcher",
          "the dispatcher lock must rank top contended under churn"),
         ("churn.wait_gap_pct",
@@ -331,12 +393,13 @@ def check(out: dict) -> int:
 def _metric_keys(out: dict) -> list:
     return ["overhead.admission_checks_per_sec",
             "overhead.pair_delta_ns", "overhead.overhead_pct",
-            "phases.coverage", "churn.wait_gap_pct",
-            "churn.tracked_wait_s"]
+            "phases.coverage", "healthwatch.polls_run",
+            "healthwatch.phantom_laps", "healthwatch.coverage",
+            "churn.wait_gap_pct", "churn.tracked_wait_s"]
 
 
 _HIGHER_IS_BETTER = ("overhead.admission_checks_per_sec",
-                     "phases.coverage")
+                     "phases.coverage", "healthwatch.coverage")
 
 
 def _lookup(out: dict, key: str):

@@ -14,6 +14,12 @@ Four legs, each a bar ``--check`` enforces:
 - **Speed**: a 1-hour virtual churn trace must replay in < 60 s wall
   (the whole point of shadow replay is that an hour of history is a
   coffee-break check, not an hour).
+- **Fleet scale**: the same record->replay harness on a 1000-node
+  (4000-chip) fleet — the one-time fleet snapshot entry and the
+  per-step view-delta entries are measured in bytes (delta encoding
+  must keep steady-state view entries orders of magnitude under the
+  snapshot), the trace must stay bit-identical on replay, and the
+  replay must hold the same < 60 s wall bar at fleet scale.
 - **Overhead**: recording must cost <= 2% of an admission check on the
   shed hot loop — same gate discipline as ``bench_profile``: the gated
   number is the quotient of two individually-stable measurements (the
@@ -47,6 +53,10 @@ OVERHEAD_BAR_PCT = 2.0
 
 CHURN_JOBS = 400            # bit-identity + perturbation workload
 HOUR_JOBS = 2600            # generated, then cut at the 1h horizon
+FLEET_NODES = 1000          # fleet-scale leg: nodes
+FLEET_JOBS = 80             # fleet-scale churn (placement at 1k nodes
+                            # is ~170 ms/pod; sized to keep the leg
+                            # inside the wall bar with margin)
 HOUR_TICK_S = 0.25          # recorded in the trace meta; replay obeys it
 SUBMITS = 20000             # overhead denominator loop
 RECORD_ITERS = 50000
@@ -147,6 +157,43 @@ def run_speed() -> dict:
             "replay_wall_s": round(replay_wall, 3),
             "speedup_x": round(virtual_s / replay_wall
                                if replay_wall > 0 else float("inf"))}
+
+
+def run_fleet_scale() -> dict:
+    """Record + replay churn on a 1000-node fleet: entry costs of the
+    fleet snapshot and the per-step view deltas, and the wall bar."""
+    from kubeshare_tpu.obs.decisions import canonical_entry
+    from kubeshare_tpu.replay import (decision_diff, record_trace,
+                                      replay_trace)
+    from kubeshare_tpu.replay.shadow import replay_wall_seconds
+    from kubeshare_tpu.sim.simulator import churn_events
+
+    events = churn_events(FLEET_JOBS, seed=SEED)
+    fleet = _fleet(n_nodes=FLEET_NODES)
+    chips = sum(len(c) for c in fleet.values())
+    rec, record_wall = replay_wall_seconds(
+        lambda: record_trace(events, fleet, seed=SEED))
+    entries = rec.entries()
+
+    def nbytes(e: dict) -> int:
+        return len(json.dumps(canonical_entry(e), sort_keys=True))
+
+    snap = next(e for e in entries if e["kind"] == "fleet")
+    views = sorted(nbytes(e) for e in entries if e["kind"] == "view")
+    rep, replay_wall = replay_wall_seconds(lambda: replay_trace(rec))
+    diff = decision_diff(entries, rep.entries())
+    return {"nodes": FLEET_NODES,
+            "chips": chips,
+            "events": len(events),
+            "entries": len(entries),
+            "fleet_snapshot_bytes": nbytes(snap),
+            "view_entries": len(views),
+            "view_delta_bytes_p50": views[len(views) // 2] if views else 0,
+            "view_delta_bytes_max": views[-1] if views else 0,
+            "record_wall_s": round(record_wall, 3),
+            "replay_wall_s": round(replay_wall, 3),
+            "bit_identical": diff["bit_identical"],
+            "identical": diff["identical"]}
 
 
 def run_overhead() -> dict:
@@ -255,6 +302,7 @@ def run_bench() -> dict:
             "identity": run_identity(),
             "perturbation": run_perturbation(),
             "speed": run_speed(),
+            "fleet_scale": run_fleet_scale(),
             "overhead": run_overhead()}
 
 
@@ -283,6 +331,19 @@ def check(out: dict) -> int:
          out["speed"]["replay_wall_s"] < SPEED_BAR_WALL_S,
          f"a 1-hour churn trace must replay in < "
          f"{SPEED_BAR_WALL_S:.0f}s wall"),
+        ("fleet_scale.bit_identical",
+         out["fleet_scale"]["bit_identical"] is True,
+         "record -> replay must stay bit-identical on the 1000-node "
+         "fleet"),
+        ("fleet_scale.replay_wall_s",
+         out["fleet_scale"]["replay_wall_s"] < SPEED_BAR_WALL_S,
+         f"the 1000-node churn trace must replay in < "
+         f"{SPEED_BAR_WALL_S:.0f}s wall"),
+        ("fleet_scale.view_delta_bytes_p50",
+         0 < out["fleet_scale"]["view_delta_bytes_p50"] * 10
+         <= out["fleet_scale"]["fleet_snapshot_bytes"],
+         "steady-state view deltas must stay at least 10x under the "
+         "full fleet snapshot (delta encoding must pay at scale)"),
         ("overhead.overhead_pct",
          out["overhead"]["overhead_pct"] <= OVERHEAD_BAR_PCT,
          f"recorder overhead on the admission hot loop must stay "
@@ -298,6 +359,9 @@ def check(out: dict) -> int:
 def _metric_keys(out: dict) -> list:
     return ["identity.entries", "perturbation.moved",
             "speed.replay_wall_s", "speed.speedup_x",
+            "fleet_scale.replay_wall_s",
+            "fleet_scale.fleet_snapshot_bytes",
+            "fleet_scale.view_delta_bytes_p50",
             "overhead.admission_checks_per_sec", "overhead.record_ns",
             "overhead.overhead_pct"]
 

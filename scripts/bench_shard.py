@@ -1,0 +1,385 @@
+"""Sharded-dispatch bench: throughput scaling, lock wait rates, p99
+placement latency, and the shard-equivalence replay gate
+(doc/sharding.md).
+
+Four legs, each a bar ``--check`` enforces:
+
+- **Scaling**: the 1k-node / 100k-pod churn stream (``sim --churn``'s
+  generator as replay events) driven closed-loop through the plane at
+  1 / 2 / 4 / 8 shards (cell route).  Each config places the same pod
+  prefix of the same stream in submit_many waves while stream deletes
+  tear churn holes; placement throughput at 4 shards must be >= 3x the
+  single-lock dispatcher.  (The full 100k-pod stream is generated and
+  its deletes drive the churn; each config *measures* a fixed pod
+  prefix — the single-lock scheduler at 1k nodes places ~6 pods/s, so
+  draining all 100k through it would take hours, not a bench.  The
+  prefix size is reported; nothing else is silently truncated.)
+- **Latency**: per-pod wall latency from wave submit to bound, p50/p99
+  per config; the 4-shard p99 must be no worse than single-lock.
+- **Lock wait**: per-shard ``kubeshare_lock_*`` wait-seconds over the
+  run, read off each shard's TrackedCondition; the worst per-shard
+  wait must stay flat (bounded by the single-lock dispatcher's own
+  wait) while the plane's throughput scales.
+- **Equivalence**: a recorded single-lock churn trace replayed through
+  the 4-shard score-route build must be shard-equivalent (same
+  pod→node multiset per spec class, same denials — zero non-equivalent
+  decisions), and replayed through the 1-shard build must stay
+  bit-identical (sharding disabled IS the old scheduler).
+
+Run: ``python scripts/bench_shard.py`` → one JSON object (committed as
+``bench_shard.json``). ``--baseline FILE`` prints deltas; ``--write
+FILE`` saves fresh numbers; ``--check`` exits 1 unless every bar holds
+(``make bench-shard`` does all three). ``--smoke`` shrinks the fleet
+and stream for CI's shard-smoke job; ``--emit-traces DIR`` writes the
+equivalence leg's recorded/sharded traces for ``topcli --replay-diff
+--shard-equiv``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SPEEDUP_BAR_4X = 3.0          # 4-shard throughput vs single-lock
+P99_TOLERANCE = 1.05          # 4-shard p99 <= single-lock p99 * this
+LOCK_WAIT_FLOOR_S = 0.05      # "flat" floor when both waits are ~0
+
+SEED = 17
+TICK_S = 0.05
+SHARD_CURVE = (1, 2, 4, 8)
+
+# full mode: the ISSUE's 1k-node / 100k-pod churn stream
+NODES = 1000
+MESH = (2, 2)
+CHURN_STREAM_PODS = 100_000
+WAVE = 64                     # pods per submit_many burst
+WAVES = 3                     # measured pods per config = WAVE * WAVES
+
+# smoke mode (CI shard-smoke): same shape, minutes -> seconds
+SMOKE_NODES = 64
+SMOKE_STREAM_PODS = 2000
+SMOKE_WAVE = 24
+SMOKE_WAVES = 2
+SMOKE_CURVE = (1, 2, 4)
+
+EQ_JOBS = 150                 # equivalence-leg churn jobs (16 nodes)
+
+
+def _fleet(n_nodes: int, mesh=MESH) -> dict:
+    """{node: [ChipInfo]} via FakeTopology — fresh objects per build."""
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=n_nodes, mesh=mesh).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    return by_host
+
+
+def _stream(n_pods: int):
+    """The churn stream as (submits, delete_t): submits keep their
+    virtual arrival times, deletes index by pod key."""
+    from kubeshare_tpu.sim.simulator import churn_events
+
+    events = churn_events(n_pods, seed=SEED)
+    submits = [e for e in events if e["op"] == "submit"]
+    delete_t = {e["key"]: e["t"] for e in events if e["op"] == "delete"}
+    return submits, delete_t
+
+
+def _shard_locks(disp) -> list:
+    shards = getattr(disp, "shards", None)
+    return [sh._cond.tracked for sh in shards] if shards \
+        else [disp._cond.tracked]
+
+
+def _drive_config(shards: int, n_nodes: int, submits: list,
+                  delete_t: dict, wave: int, waves: int) -> dict:
+    """Closed-loop drive: submit_many a wave, step the plane until the
+    wave resolves (stream deletes applied at their virtual times), for
+    ``waves`` waves.  Wall time over placed pods is the throughput."""
+    from kubeshare_tpu.replay.shadow import VirtualClock
+    from kubeshare_tpu.scheduler.shard import make_dispatcher
+
+    clock = VirtualClock(0.0)
+    disp = make_dispatcher(_fleet(n_nodes), shards=shards, route="cell",
+                           clock=clock)
+    locks = _shard_locks(disp)
+    base = [(lk.wait_total_s, lk.hold_total_s, lk.acquisitions)
+            for lk in locks]
+    deletes: list = []              # (virtual_t, key) for placed pods
+    latencies: list[float] = []
+    placed = failed = deleted_n = 0
+    it = iter(submits)
+    t0 = time.perf_counter()
+    for _ in range(waves):
+        batch = []
+        for _i in range(wave):
+            ev = next(it, None)
+            if ev is None:
+                break
+            batch.append(ev)
+        if not batch:
+            break
+        # the stream's arrival clock, so stream deletes come due and
+        # keep tearing churn holes between waves
+        clock.t = max(clock.t, max(e["t"] for e in batch))
+        while deletes and deletes[0][0] <= clock.t:
+            _, key = heapq.heappop(deletes)
+            disp.delete(key)
+            deleted_n += 1
+        wave_wall = time.perf_counter()
+        disp.submit_many([(e["namespace"], e["name"], dict(e["labels"]))
+                          for e in batch])
+        waiting = {f"{e['namespace']}/{e['name']}" for e in batch}
+        guard = 0
+        while waiting:
+            clock.t = round(clock.t + TICK_S, 6)
+            disp.step(clock.t)
+            pend, park = disp._pending, disp._parked
+            done = [k for k in waiting if k not in pend and k not in park]
+            now_wall = time.perf_counter()
+            for k in done:
+                waiting.discard(k)
+                out = disp.outcome(k)
+                if out is not None and out.status == "bound":
+                    placed += 1
+                    latencies.append(now_wall - wave_wall)
+                    end = delete_t.get(k)
+                    if end is not None:
+                        heapq.heappush(
+                            deletes, (max(end, clock.t + TICK_S), k))
+                else:
+                    failed += 1
+            guard += 1
+            if guard > 10_000:
+                raise RuntimeError(
+                    f"{shards}-shard drive stuck: {len(waiting)} pods "
+                    f"never resolved")
+    wall = time.perf_counter() - t0
+    lock_rows = []
+    for lk, (w0, h0, a0) in zip(locks, base):
+        lock_rows.append({
+            "name": lk.name,
+            "acquisitions": lk.acquisitions - a0,
+            "wait_s": round(lk.wait_total_s - w0, 6),
+            "hold_s": round(lk.hold_total_s - h0, 6),
+        })
+    lat = sorted(latencies)
+
+    def pct(q: float) -> float:
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(round(q * (len(lat) - 1))))]
+
+    return {
+        "shards": shards,
+        "placed": placed,
+        "failed": failed,
+        "churn_deletes": deleted_n,
+        "wall_s": round(wall, 3),
+        "pods_per_sec": round(placed / wall, 2) if wall > 0 else 0.0,
+        "p50_place_s": round(statistics.median(lat), 4) if lat else 0.0,
+        "p99_place_s": round(pct(0.99), 4),
+        "lock_wait_max_s": round(max(r["wait_s"] for r in lock_rows), 6),
+        "locks": lock_rows,
+    }
+
+
+def run_scaling(smoke: bool) -> dict:
+    n_nodes = SMOKE_NODES if smoke else NODES
+    stream_pods = SMOKE_STREAM_PODS if smoke else CHURN_STREAM_PODS
+    wave = SMOKE_WAVE if smoke else WAVE
+    waves = SMOKE_WAVES if smoke else WAVES
+    curve = SMOKE_CURVE if smoke else SHARD_CURVE
+    submits, delete_t = _stream(stream_pods)
+    out = {
+        "nodes": n_nodes,
+        "churn_stream_pods": stream_pods,
+        "measured_pods_per_config": wave * waves,
+        "wave": wave,
+        "configs": {},
+    }
+    for shards in curve:
+        out["configs"][str(shards)] = _drive_config(
+            shards, n_nodes, submits, delete_t, wave, waves)
+    base = out["configs"]["1"]["pods_per_sec"] or 1e-9
+    for shards in curve[1:]:
+        cfg = out["configs"][str(shards)]
+        out[f"speedup_{shards}x"] = round(cfg["pods_per_sec"] / base, 2)
+    return out
+
+
+def run_equivalence(emit_dir: Path | None) -> dict:
+    """Record single-lock, replay sharded (score route): the multiset
+    gate; replay 1-shard: the bit-identity gate."""
+    from kubeshare_tpu.obs.decisions import trace_jsonl
+    from kubeshare_tpu.replay import (decision_diff, record_trace,
+                                      replay_trace)
+    from kubeshare_tpu.sim.simulator import churn_events
+
+    events = churn_events(EQ_JOBS, seed=SEED)
+    fleet = {host: [c.to_labels() for c in chips]
+             for host, chips in _fleet(16).items()}
+    rec = record_trace(events, fleet, seed=SEED)
+    rep4 = replay_trace(rec, config={"shards": 4})
+    diff4 = decision_diff(rec.entries(), rep4.entries(),
+                          shard_equivalence=True)
+    rep1 = replay_trace(rec)
+    diff1 = decision_diff(rec.entries(), rep1.entries())
+    if emit_dir is not None:
+        emit_dir.mkdir(parents=True, exist_ok=True)
+        (emit_dir / "recorded.jsonl").write_text(trace_jsonl(rec))
+        (emit_dir / "sharded.jsonl").write_text(trace_jsonl(rep4))
+    return {
+        "jobs": EQ_JOBS,
+        "entries": len(rec.entries()),
+        "sharded_equivalent": diff4["identical"],
+        "sharded_moved_classes": len(diff4["moved"]),
+        "sharded_denied": len(diff4["denied"]),
+        "single_shard_bit_identical": diff1["bit_identical"],
+        "single_shard_identical": diff1["identical"],
+    }
+
+
+def run_bench(smoke: bool = False, emit_dir: Path | None = None) -> dict:
+    return {
+        "bench": "sharded dispatch: churn throughput scaling across "
+                 "1/2/4/8 cell-keyed shards, per-shard lock wait, p99 "
+                 "placement latency, shard-equivalence replay gate",
+        "smoke": smoke,
+        "scaling": run_scaling(smoke),
+        "equivalence": run_equivalence(emit_dir),
+    }
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (ISSUE 17 / doc/sharding.md)."""
+    sc = out["scaling"]
+    one = sc["configs"]["1"]
+    four = sc["configs"]["4"]
+    bars = [
+        ("scaling.speedup_4x",
+         sc["speedup_4x"] >= SPEEDUP_BAR_4X,
+         f"4-shard placement throughput must be >= "
+         f"{SPEEDUP_BAR_4X:g}x single-lock on the churn stream"),
+        ("scaling.configs.4.p99_place_s",
+         four["p99_place_s"] <= one["p99_place_s"] * P99_TOLERANCE,
+         "4-shard p99 placement latency must be no worse than "
+         "single-lock"),
+        ("scaling.configs.4.lock_wait_max_s",
+         four["lock_wait_max_s"]
+         <= max(one["lock_wait_max_s"], LOCK_WAIT_FLOOR_S),
+         "per-shard lock wait-seconds must stay flat while the plane's "
+         "throughput scales"),
+        ("equivalence.sharded_equivalent",
+         out["equivalence"]["sharded_equivalent"] is True,
+         "a single-lock trace replayed through the 4-shard score build "
+         "must report zero non-equivalent decisions"),
+        ("equivalence.single_shard_bit_identical",
+         out["equivalence"]["single_shard_bit_identical"] is True,
+         "the 1-shard build must stay decision-bit-identical to the "
+         "single-lock scheduler"),
+    ]
+    failed = [f"{name}: {why} (got {_lookup(out, name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _metric_keys(out: dict) -> list:
+    keys = []
+    for shards in sorted(out["scaling"]["configs"], key=int):
+        keys.append(f"scaling.configs.{shards}.pods_per_sec")
+        keys.append(f"scaling.configs.{shards}.p99_place_s")
+        keys.append(f"scaling.configs.{shards}.lock_wait_max_s")
+    for k in sorted(out["scaling"]):
+        if k.startswith("speedup_"):
+            keys.append(f"scaling.{k}")
+    keys.append("equivalence.sharded_moved_classes")
+    return keys
+
+
+_HIGHER_IS_BETTER = tuple(
+    [f"scaling.configs.{s}.pods_per_sec" for s in (1, 2, 4, 8)]
+    + [f"scaling.speedup_{s}x" for s in (2, 4, 8)])
+
+
+def _lookup(out: dict, key: str):
+    node = out
+    for part in key.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    if base.get("smoke") != fresh.get("smoke"):
+        print(f"# baseline {baseline_path} is a different mode "
+              f"(smoke={base.get('smoke')}); skipping deltas",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = _lookup(fresh, key), _lookup(base, key)
+        if new is None or old is None:
+            print(f"#   {key:44s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:44s} {old!s:>10} -> {new!s:>10}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_shard")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the >=3x 4-shard speedup, "
+                             "p99-no-worse, flat-lock-wait and "
+                             "shard-equivalence bars hold")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI mode: 64-node fleet, short stream — "
+                             "same bars, seconds instead of minutes")
+    parser.add_argument("--emit-traces", type=Path, default=None,
+                        metavar="DIR",
+                        help="write the equivalence leg's recorded + "
+                             "sharded traces to DIR for topcli "
+                             "--replay-diff --shard-equiv")
+    args = parser.parse_args(argv)
+    import logging
+    logging.disable(logging.CRITICAL)   # churn sheds are deliberate
+    out = run_bench(smoke=args.smoke, emit_dir=args.emit_traces)
+    logging.disable(logging.NOTSET)
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -487,7 +487,7 @@ def test_scenario_builders_are_seed_deterministic():
     assert any([a.to_dict() for a in build(name, 13).actions]
                != [a.to_dict() for a in build(name, 14).actions]
                for name in BUILDERS)
-    assert len(all_scenarios(0)) == len(BUILDERS) == 8
+    assert len(all_scenarios(0)) == len(BUILDERS) == 9
 
 
 def test_partitioned_registry_fails_calls_during_window():
@@ -520,12 +520,27 @@ def test_run_suite_zero_violations_full_convergence():
     report = run_suite(seed=3)
     assert report["invariant_violations"] == 0
     assert report["converged"]
-    assert len(report["scenarios"]) == 8
+    assert len(report["scenarios"]) == 9
     for scn in report["scenarios"]:
         assert scn["converged"], scn["scenario"]
         assert scn["violations"] == [], scn["scenario"]
         assert scn["mttr_s"] >= 0.0
         assert scn["samples"] > 0
+
+
+def test_run_scenario_sharded_cross_shard_commit_fail():
+    # the mid-commit shard-failure nemesis against a 2-shard plane:
+    # the gang spans both subtrees, the injected commit failure rolls
+    # back cleanly, and the cross-shard invariants (no double booking,
+    # gang atomicity) hold through recovery
+    report = run_scenario("cross-shard-gang-commit-fail", seed=3,
+                          shards=2)
+    assert report["converged"] and report["violations"] == []
+    assert report["mttr_s"] >= 0.0
+    # same scenario on the single-lock plane: the injection no-ops
+    # (no cross-shard protocol exists) and the run stays green
+    single = run_scenario("cross-shard-gang-commit-fail", seed=3)
+    assert single["converged"] and single["violations"] == []
 
 
 def test_run_matrix_aggregates_mttr_percentiles():

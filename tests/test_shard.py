@@ -1,0 +1,458 @@
+"""Sharded dispatch tests (doc/sharding.md): the shard plan, batched
+admission, cell-route placement + spillover, the cross-shard gang
+trial-book→commit (and its rollback under injected mid-commit failure),
+score-route placement parity with the single-lock dispatcher, merged
+decision recording, the event-driven healthwatch bracket, and the new
+cross-shard chaos invariants."""
+
+import pytest
+
+from kubeshare_tpu import constants as C
+from kubeshare_tpu.chaos import invariants
+from kubeshare_tpu.obs.decisions import DecisionRecorder
+from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+from kubeshare_tpu.scheduler.healthwatch import HealthWatch
+from kubeshare_tpu.scheduler.shard import (ShardPlan, ShardedDispatcher,
+                                           build_sharded, make_dispatcher)
+from kubeshare_tpu.telemetry import TelemetryRegistry
+from kubeshare_tpu.topology.discovery import FakeTopology
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_fleet(hosts=4, mesh=(2, 2)):
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=hosts, mesh=mesh).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    return by_host
+
+
+def shared(request="0.5", limit="1.0", **extra):
+    labels = {C.POD_TPU_REQUEST: request, C.POD_TPU_LIMIT: limit}
+    labels.update(extra)
+    return labels
+
+
+def gang(name, headcount=4, threshold=1.0, priority="10", **kw):
+    return shared(**{C.POD_GROUP_NAME: name,
+                     C.POD_GROUP_HEADCOUNT: str(headcount),
+                     C.POD_GROUP_THRESHOLD: str(threshold),
+                     C.POD_PRIORITY: priority}, **kw)
+
+
+def names_homing_to(plane, shard, count, prefix="p", labels=None):
+    """Pod names whose home shard is *shard* (stable crc routing)."""
+    out, i = [], 0
+    while len(out) < count:
+        nm = f"{prefix}{i}"
+        if plane.home_shard("ns", nm, labels) == shard:
+            out.append(nm)
+        i += 1
+    return out
+
+
+def gang_name_homing_to(plane, shard, prefix="g"):
+    i = 0
+    while True:
+        nm = f"{prefix}{i}"
+        if plane.home_shard("ns", "member",
+                            {C.POD_GROUP_NAME: nm}) == shard:
+            return nm
+        i += 1
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+# -- shard plan ---------------------------------------------------------
+
+
+def test_shard_plan_deterministic_balanced():
+    fleet = make_fleet(hosts=8, mesh=(2, 2))
+    a = ShardPlan(fleet, 4)
+    b = ShardPlan(fleet, 4)
+    assert a.assign == b.assign                      # deterministic
+    sizes = [len(a.nodes_of(i)) for i in range(4)]
+    assert sum(sizes) == 8 and min(sizes) >= 1       # all nodes, no
+    assert max(sizes) - min(sizes) <= 1              # empty shard
+    # contiguity: sorted node order maps to non-decreasing shard ids
+    shards_in_order = [a.assign[n] for n in sorted(fleet)]
+    assert shards_in_order == sorted(shards_in_order)
+    # a node the plan never saw still routes stably
+    assert a.shard_of("tpu-host-99") == a.shard_of("tpu-host-99")
+
+
+def test_make_dispatcher_single_shard_is_plain_dispatcher(clock):
+    d = make_dispatcher(make_fleet(hosts=2), shards=1, clock=clock)
+    assert isinstance(d, Dispatcher) and not isinstance(
+        d, ShardedDispatcher)
+    key = d.submit("ns", "p", shared())
+    d.step()
+    assert d.outcome(key).status == "bound"
+
+
+# -- cell route ---------------------------------------------------------
+
+
+def test_cell_route_binds_on_home_shard(clock):
+    plane = build_sharded(make_fleet(hosts=4), 2, clock=clock,
+                          route="cell")
+    keys = {}
+    for nm in names_homing_to(plane, 0, 2) + names_homing_to(plane, 1, 2):
+        keys[nm] = plane.submit("ns", nm, shared())
+    plane.step()
+    for nm, key in keys.items():
+        out = plane.outcome(key)
+        assert out is not None and out.status == "bound"
+        home = plane.shards[plane.home_shard("ns", nm)]
+        assert out.binding.node in home.engine.nodes
+    snap = plane.invariant_snapshot()
+    assert snap["ok"], snap["violations"]
+    assert snap["shards"] == 2
+
+
+def test_batched_admission_one_lock_acquisition_per_shard(clock):
+    plane = build_sharded(make_fleet(hosts=4), 2, clock=clock,
+                          route="cell")
+    items = ([("ns", nm, shared()) for nm in
+              names_homing_to(plane, 0, 5, prefix="a")]
+             + [("ns", nm, shared()) for nm in
+                names_homing_to(plane, 1, 5, prefix="b")])
+    before = [sh._cond.tracked.acquisitions for sh in plane.shards]
+    keys = plane.submit_many(items)
+    after = [sh._cond.tracked.acquisitions for sh in plane.shards]
+    assert all(isinstance(k, str) for k in keys)
+    assert len(keys) == 10
+    # ONE acquisition per shard for the whole burst, not one per pod
+    assert [a - b for a, b in zip(after, before)] == [1, 1]
+    # results come back in submission order regardless of shard grouping
+    assert keys == [f"ns/{item[1]}" for item in items]
+
+
+def test_spillover_rehomes_pod_from_full_shard(clock):
+    # 2 shards x 1 node x 2 whole-chip leaves
+    plane = build_sharded(make_fleet(hosts=2, mesh=(2,)), 2, clock=clock,
+                          route="cell")
+    blockers = names_homing_to(plane, 0, 2, prefix="blk")
+    for nm in blockers:
+        plane.submit("ns", nm, shared("1", "1"))
+    plane.step()
+    # shard 0's node is now full; a third whole-chip pod homing there
+    # must spill to shard 1
+    spiller = names_homing_to(plane, 0, 1, prefix="sp")[0]
+    key = plane.submit("ns", spiller, shared("1", "1"))
+    clock.t += 1.0
+    plane.step()          # home fails -> event -> pump transfers
+    clock.t += 1.0
+    plane.step()          # new home binds it
+    out = plane.outcome(key)
+    assert out is not None and out.status == "bound"
+    assert out.binding.node in plane.shards[1].engine.nodes
+    assert plane.invariant_snapshot()["ok"]
+
+
+# -- cross-shard gang ---------------------------------------------------
+
+
+def _gang_plane(clock):
+    """2 shards x 1 node x 2 whole chips; a 4-member whole-chip gang can
+    only exist ACROSS both shards."""
+    plane = build_sharded(make_fleet(hosts=2, mesh=(2,)), 2, clock=clock,
+                          route="cell")
+    gname = gang_name_homing_to(plane, 0)
+    keys = [plane.submit("ns", f"{gname}-{i}",
+                         gang(gname, headcount=4, request="1", limit="1"))
+            for i in range(4)]
+    return plane, gname, keys
+
+
+def test_cross_shard_gang_binds_all_or_nothing(clock):
+    plane, gname, keys = _gang_plane(clock)
+    plane.step()
+    outs = [plane.outcome(k) for k in keys]
+    assert all(o is not None and o.status == "bound" for o in outs), [
+        plane.status(k) for k in keys]
+    nodes = sorted({o.binding.node for o in outs})
+    assert len(nodes) == 2              # genuinely spans both subtrees
+    ranks = sorted(plane.engine.pod_status[k].group_rank for k in keys)
+    assert ranks == [0, 1, 2, 3]        # dense, no cross-shard collision
+    snap = plane.invariant_snapshot()
+    assert snap["ok"], snap["violations"]
+
+
+def test_cross_shard_gang_rolls_back_on_mid_commit_failure(clock):
+    plane, gname, keys = _gang_plane(clock)
+    plane.fail_commit_at = 2            # die after 2 members committed
+    plane.step()
+    # all-or-nothing: NOTHING stayed bound, every booking reclaimed
+    assert all(plane.outcome(k) is None for k in keys)
+    for sh in plane.shards:
+        for cell in sh.engine.leaf_cells.values():
+            assert cell.available == cell.leaf_cell_number
+    for k in keys:
+        pod = plane.engine.pod_status[k]
+        assert pod.node_name == "" and pod.group_rank == -1
+        assert not pod.bookings
+    snap = plane.invariant_snapshot()
+    assert snap["ok"], snap["violations"]
+    assert plane.fail_commit_at is None     # injection is one-shot
+    # the gang is whole in home's pending queue and the next attempt
+    # (after retry backoff) succeeds
+    clock.t += 2.0
+    plane.step()
+    assert all(plane.outcome(k) is not None
+               and plane.outcome(k).status == "bound" for k in keys)
+    assert plane.invariant_snapshot()["ok"]
+
+
+# -- score route: placement parity --------------------------------------
+
+
+def test_score_route_matches_single_lock_placements(clock):
+    fleet = make_fleet(hosts=4)
+    single = make_dispatcher(fleet, shards=1, clock=clock)
+    plane = build_sharded(fleet, 2, clock=clock, route="score")
+    pods = [(f"ns{i % 3}", f"pod-{i}", shared("0.5", "1.0"))
+            for i in range(12)]
+    for ns, nm, labels in pods:
+        single.submit(ns, nm, labels)
+        plane.submit(ns, nm, labels)
+    single.step()
+    plane.step()
+    for ns, nm, _labels in pods:
+        key = f"{ns}/{nm}"
+        a, b = single.outcome(key), plane.outcome(key)
+        assert a is not None and b is not None
+        assert a.status == b.status == "bound"
+        assert a.binding.node == b.binding.node, key
+    assert plane.invariant_snapshot()["ok"]
+
+
+def test_score_route_rehomes_record_with_foreign_placement(clock):
+    # score route places globally in the SAME step, no spill event
+    # needed: 3 whole-chip pods homing to shard 0 (2-chip subtree) —
+    # at least one MUST land on shard 1, and its record moves with it
+    plane = build_sharded(make_fleet(hosts=2, mesh=(2,)), 2, clock=clock,
+                          route="score")
+    keys = [plane.submit("ns", nm, shared("1", "1"))
+            for nm in names_homing_to(plane, 0, 3)]
+    plane.step()
+    for key in keys:
+        out = plane.outcome(key)
+        assert out is not None and out.status == "bound"
+        # single ownership: the record lives EXACTLY on the shard whose
+        # subtree holds the placement
+        owner = plane.plan.shard_of(out.binding.node)
+        assert key in plane.shards[owner].engine.pod_status
+        assert key not in plane.shards[1 - owner].engine.pod_status
+    foreign = [k for k in keys
+               if plane.outcome(k).binding.node
+               in plane.shards[1].engine.nodes]
+    assert foreign                       # the home subtree couldn't
+    assert plane.invariant_snapshot()["ok"]  # hold all three
+
+
+# -- decision recording -------------------------------------------------
+
+
+def test_shared_recorder_merged_fleet_and_views(clock):
+    plane = build_sharded(make_fleet(hosts=4), 2, clock=clock,
+                          route="cell")
+    rec = DecisionRecorder(clock=clock)
+    plane.attach_decisions(rec)
+    fleet_entries = [e for e in rec.entries() if e["kind"] == "fleet"]
+    assert len(fleet_entries) == 1               # ONE merged fleet entry
+    assert len(fleet_entries[0]["nodes"]) == 4   # ... covering all nodes
+    assert rec.meta["shards"] == 2
+    for nm in names_homing_to(plane, 0, 1) + names_homing_to(plane, 1, 1):
+        plane.submit("ns", nm, shared())
+    plane.step()
+    views = [e for e in rec.entries() if e["kind"] == "view"]
+    assert views, "no view entry recorded"
+    # partial per-shard views would fabricate drop entries for the
+    # OTHER shard's nodes; the merged view must never drop a live node
+    for v in views:
+        assert v["drop"] == []
+    assert set(views[0]["set"]) == set(plane.engine.nodes)
+    # the step after the binds records their capacity delta (the view is
+    # taken pre-drain, like the single-lock _pre_pass); after that the
+    # summed-gen gate holds: an idle step records NO new view
+    plane.step()
+    n = len([e for e in rec.entries() if e["kind"] == "view"])
+    plane.step()
+    assert len([e for e in rec.entries()
+                if e["kind"] == "view"]) == n
+
+
+# -- event-driven healthwatch (phantom-coverage fix) --------------------
+
+
+def test_healthwatch_phase_only_lapped_when_poll_due(clock):
+    eng_disp = make_dispatcher(make_fleet(hosts=2), shards=1, clock=clock)
+    hw = HealthWatch(TelemetryRegistry(), poll_period_s=10.0,
+                     clock=clock)
+    eng_disp.attach_healthwatch(hw)
+    eng_disp.step()                       # t=100: due -> polls
+    assert hw.due(clock.t) is False
+    laps = eng_disp.prof_phases.phase_counts.get("healthwatch", 0)
+    assert laps == 1
+    clock.t += 1.0
+    eng_disp.step()                       # t=101: NOT due -> no lap
+    assert eng_disp.prof_phases.phase_counts.get("healthwatch", 0) == laps
+    clock.t += 10.0
+    eng_disp.step()                       # t=111: due again
+    assert eng_disp.prof_phases.phase_counts.get(
+        "healthwatch", 0) == laps + 1
+
+
+def test_sharded_healthwatch_runs_on_pump_not_in_shard_phases(clock):
+    plane = build_sharded(make_fleet(hosts=2), 2, clock=clock,
+                          route="cell")
+    hw = HealthWatch(TelemetryRegistry(), poll_period_s=10.0,
+                     clock=clock)
+    plane.attach_healthwatch(hw)
+    plane.step()
+    for sh in plane.shards:
+        assert "healthwatch" not in sh.prof_phases.phase_counts
+    assert plane.prof_pump.phase_counts.get("healthwatch", 0) == 1
+
+
+# -- replay: shard equivalence ------------------------------------------
+
+
+def _synthetic_traces(replay_node_a):
+    labels = {C.POD_TPU_REQUEST: "0.5", C.POD_TPU_LIMIT: "1.0"}
+    rec = [
+        {"kind": "submit", "pod": "ns/a", "labels": dict(labels),
+         "t": 0.0, "seq": 0},
+        {"kind": "submit", "pod": "ns/b", "labels": dict(labels),
+         "t": 0.0, "seq": 1},
+        {"kind": "outcome", "pod": "ns/a", "status": "bound",
+         "node": "n1", "t": 0.1, "seq": 2},
+        {"kind": "outcome", "pod": "ns/b", "status": "bound",
+         "node": "n2", "t": 0.1, "seq": 3},
+    ]
+    rep = [dict(e) for e in rec]
+    rep[2]["node"] = replay_node_a          # pod a placed elsewhere
+    rep[3]["node"] = "n1"                   # pod b took n1
+    rep[2]["t"] = rep[3]["t"] = 5.0         # ... and much later
+    rep[2], rep[3] = rep[3], rep[2]         # ... in swapped entry order
+    return rec, rep
+
+
+def test_diff_pure_reordering_is_shard_equivalent():
+    from kubeshare_tpu.replay.diff import decision_diff
+
+    # a and b are spec-identical; the candidate swapped their nodes and
+    # bound them later — the schedule (class -> node multiset) is the
+    # same, so shard equivalence holds while the strict diff flags it
+    rec, rep = _synthetic_traces(replay_node_a="n2")
+    strict = decision_diff(rec, rep)
+    assert not strict["identical"] and len(strict["moved"]) == 2
+    equiv = decision_diff(rec, rep, shard_equivalence=True)
+    assert equiv["identical"], equiv["moved"]
+    assert equiv["equivalence"] == "shard"
+    assert equiv["moved"] == []
+
+
+def test_diff_real_move_breaks_shard_equivalence():
+    from kubeshare_tpu.replay.diff import decision_diff
+
+    # pod a moved to a node its class never used — the node multiset
+    # changed; equivalence mode must STILL flag it
+    rec, rep = _synthetic_traces(replay_node_a="n3")
+    equiv = decision_diff(rec, rep, shard_equivalence=True)
+    assert not equiv["identical"]
+    assert equiv["moved"]
+    assert equiv["moved"][0]["class_recorded"] == {"n1": 1, "n2": 1}
+    assert equiv["moved"][0]["class_replayed"] == {"n1": 1, "n3": 1}
+
+
+def test_recorded_single_lock_trace_replays_shard_equivalent():
+    """THE rollout gate: a single-lock churn trace replayed through a
+    4-shard score-route build re-derives an equivalent schedule."""
+    from kubeshare_tpu.obs.decisions import parse_trace_jsonl, trace_jsonl
+    from kubeshare_tpu.replay.diff import decision_diff
+    from kubeshare_tpu.replay.shadow import record_trace, replay_trace
+
+    fleet_nodes = {node: [c.to_labels() for c in chips]
+                   for node, chips in make_fleet(hosts=4).items()}
+    events = []
+    for i in range(24):
+        events.append({"t": 0.1 * i, "op": "submit",
+                       "namespace": f"ns{i % 3}", "name": f"c-{i}",
+                       "labels": shared("0.5", "1.0")})
+    for i in range(0, 12, 2):
+        events.append({"t": 1.5 + 0.1 * i, "op": "delete",
+                       "key": f"ns{i % 3}/c-{i}"})
+    truth = record_trace(events, fleet_nodes, seed=11)
+    sharded = replay_trace(truth, config={"shards": 4,
+                                          "shard_route": "score"})
+    diff = decision_diff(
+        parse_trace_jsonl(trace_jsonl(truth))["entries"],
+        parse_trace_jsonl(trace_jsonl(sharded))["entries"],
+        shard_equivalence=True)
+    assert diff["identical"], (diff["moved"], diff["denied"],
+                               diff["missing"], diff["extra"])
+    # and the single-shard replay of the same trace stays STRICTLY
+    # identical — sharding disabled is the old code path, bit for bit
+    single = replay_trace(truth, config={"shards": 1})
+    strict = decision_diff(
+        parse_trace_jsonl(trace_jsonl(truth))["entries"],
+        parse_trace_jsonl(trace_jsonl(single))["entries"])
+    assert strict["identical"], strict
+
+
+# -- cross-shard invariants ---------------------------------------------
+
+
+def test_check_cross_shard_detects_double_registration(clock):
+    plane = build_sharded(make_fleet(hosts=2), 2, clock=clock,
+                          route="cell")
+    nm = names_homing_to(plane, 0, 1)[0]
+    key = plane.submit("ns", nm, shared())
+    plane.step()
+    assert plane.outcome(key).status == "bound"
+    # plant the violation: the same pod record on BOTH shard engines
+    pod = plane.shards[0].engine.pod_status.get(key) \
+        or plane.shards[1].engine.pod_status[key]
+    other = plane.shards[1 - plane.plan.shard_of(pod.node_name)]
+    other.engine.pod_status[key] = pod
+    snap = plane.invariant_snapshot()
+    assert not snap["ok"]
+    assert any(v["invariant"] == "cross-shard-pod-ownership"
+               for v in snap["violations"])
+
+
+def test_check_cross_shard_detects_torn_gang():
+    # two bare engines holding a half-bound gang between them
+    from kubeshare_tpu.scheduler.engine import SchedulerEngine
+    from kubeshare_tpu.scheduler.labels import parse_pod_labels
+
+    e0, e1 = SchedulerEngine(), SchedulerEngine()
+    fleet = make_fleet(hosts=2, mesh=(2,))
+    hosts = sorted(fleet)
+    e0.set_fleet({hosts[0]: (fleet[hosts[0]], True)})
+    e1.set_fleet({hosts[1]: (fleet[hosts[1]], True)})
+    labels = gang("tg", headcount=2, request="1", limit="1")
+    m0 = parse_pod_labels("ns", "tg-0", labels)
+    m1 = parse_pod_labels("ns", "tg-1", labels)
+    e0.pod_status[m0.key] = m0
+    e1.pod_status[m1.key] = m1
+    m0.group_rank = 0
+    e0.reserve(m0, hosts[0])        # one member bound, sibling dangling
+    out = invariants.check_cross_shard([e0, e1])
+    assert any(v["invariant"] == "cross-shard-gang-atomicity"
+               for v in out)
+    # ... and a whole gang (or none) is clean
+    m1.group_rank = 1
+    e1.reserve(m1, hosts[1])
+    assert invariants.check_cross_shard([e0, e1]) == []
