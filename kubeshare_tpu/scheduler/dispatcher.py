@@ -671,7 +671,7 @@ class Dispatcher:
             if pod.deadline_s > 0:
                 nxt = min(nxt, pod.timestamp + pod.deadline_s)
         if self.healthwatch is not None:
-            nxt = min(nxt, self.healthwatch._next_poll)
+            nxt = min(nxt, now + self.healthwatch.seconds_until_due(now))
         return max(0.0, nxt - now)
 
     def _pick(self, now: float) -> str | None:

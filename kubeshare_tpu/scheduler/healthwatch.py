@@ -130,6 +130,13 @@ class HealthWatch:
         sharded plane's event pump uses it to skip idle cycles."""
         return now >= self._next_poll
 
+    def seconds_until_due(self, now: float) -> float:
+        """Seconds until :meth:`poll` would next do real work (0.0 when
+        already due) — the public cadence surface the dispatcher's
+        next-event delay and the sharded pump schedule against, instead
+        of reaching into the poll timer directly."""
+        return max(0.0, self._next_poll - now)
+
     def poll(self, now: float, dispatcher=None) -> list[str]:
         """Advance every node's state machine; returns nodes whose state
         changed. Runs under the dispatcher lock (its step calls this) —

@@ -29,7 +29,9 @@ Two routing policies:
   placement runs the *global* filter→score→normalize walk across every
   shard's engine — byte-for-byte the same candidate ordering as
   ``engine.schedule`` on a single fleet-wide engine — and commits the
-  reservation on the owning shard.  A recorded single-lock trace
+  reservation on the owning shard.  The drain holds ALL shard locks
+  (ascending) for the pass: it reads and mutates every engine, so it
+  deliberately trades the cell route's parallelism for parity.  A recorded single-lock trace
   replayed through this mode re-derives the *same pod→node multiset*
   (the replay-diff shard-equivalence gate), which is what lets a
   sharding rollout be verified against production traces before the
@@ -174,15 +176,21 @@ class _FleetEngine:
     def __init__(self, plane: "ShardedDispatcher"):
         self._plane = plane
 
-    def _owner(self, node: str) -> SchedulerEngine:
-        return self._plane.shards[self._plane.plan.shard_of(node)].engine
+    def _owner(self, node: str) -> Dispatcher:
+        return self._plane.shards[self._plane.plan.shard_of(node)]
 
-    # -- per-node mutators (serialized by the caller or the pump) ------
+    # -- per-node mutators (under the owning shard's lock: the pump's
+    # healthwatch poll runs OFF the shard locks, and handler threads
+    # step/submit/delete concurrently) ---------------------------------
     def veto_health(self, node: str, vetoed: bool) -> None:
-        self._owner(node).veto_health(node, vetoed)
+        sh = self._owner(node)
+        with sh._cond:
+            sh.engine.veto_health(node, vetoed)
 
     def set_node_health(self, node: str, healthy: bool) -> None:
-        self._owner(node).set_node_health(node, healthy)
+        sh = self._owner(node)
+        with sh._cond:
+            sh.engine.set_node_health(node, healthy)
 
     # -- merged read views ---------------------------------------------
     @property
@@ -306,6 +314,13 @@ class ShardedDispatcher:
         self.fail_commit_at: int | None = None
         #: summed per-engine alloc_gen at the last merged view entry
         self._view_gen: int | None = None
+        #: plane-wide step serialization: the service steps from HTTP
+        #: handler threads while _run steps on its own thread — two
+        #: concurrent drains (or a drain racing the pump's cross-shard
+        #: machinery) would interleave between per-shard lock windows.
+        #: Tracked, so /prof shows plane-step contention; re-entrant,
+        #: matching the per-shard dispatcher lock's discipline.
+        self._step_lock = obs_prof.TrackedRLock("dispatcher-plane-step")
         self._stop = False
         self._thread: threading.Thread | None = None
         for i, sh in enumerate(shards):
@@ -380,20 +395,33 @@ class ShardedDispatcher:
 
     # -- intake (single-dispatcher surface) ----------------------------
 
+    def _submit_shard(self, namespace: str, name: str,
+                      labels: dict) -> int:
+        """Where a submit must land: after a spill/re-home the pod's
+        engine record (and any live booking) lives on a FOREIGN shard —
+        an idempotent resubmit routed by home would mint a duplicate
+        record there and could bind the same pod onto a second node,
+        the cross-shard double-ownership :meth:`delete` guards against.
+        Mirror it: the owning engine first, home only for unknown keys."""
+        owner = self._engine_owner(f"{namespace}/{name}")
+        if owner is not None:
+            return owner.shard_id
+        return self.home_shard(namespace, name, labels)
+
     def submit(self, namespace: str, name: str, labels: dict,
                uid: str = "") -> str:
-        sh = self.shards[self.home_shard(namespace, name, labels)]
+        sh = self.shards[self._submit_shard(namespace, name, labels)]
         return sh.submit(namespace, name, labels, uid=uid)
 
     def submit_many(self, items) -> list:
-        """Batched admission across shards: the burst is grouped by home
-        shard and each group lands under ONE acquisition of that shard's
-        lock (one per shard per burst, not one per pod)."""
+        """Batched admission across shards: the burst is grouped by
+        owning/home shard and each group lands under ONE acquisition of
+        that shard's lock (one per shard per burst, not one per pod)."""
         groups: dict[int, list] = {}
         order = []
         for idx, item in enumerate(items):
             ns, name, labels = item[0], item[1], item[2]
-            shard = self.home_shard(ns, name, labels)
+            shard = self._submit_shard(ns, name, labels)
             groups.setdefault(shard, []).append((idx, item))
             order.append(None)
         for shard, batch in sorted(groups.items()):
@@ -535,15 +563,20 @@ class ShardedDispatcher:
         """One plane-wide tick: per-shard housekeeping, the scheduling
         pass (global order under ``route="score"``, independent shards
         under ``route="cell"``), cross-shard spill/gang work, then the
-        event pump.  Returns seconds until the next timed event."""
-        now = self._clock() if now is None else now
-        self._record_view(now)
-        if self.route == "score":
-            delay = self._step_score(now)
-        else:
-            delay = self._step_cell(now)
-        pump_delay = self._pump(now)
-        return max(0.0, min(delay, pump_delay))
+        event pump.  Returns seconds until the next timed event.
+
+        Serialized plane-wide: the service steps synchronously from
+        HTTP handler threads while the ``_run`` thread steps on its
+        own cadence — only one tick may be in flight at a time."""
+        with self._step_lock:
+            now = self._clock() if now is None else now
+            self._record_view(now)
+            if self.route == "score":
+                delay = self._step_score(now)
+            else:
+                delay = self._step_cell(now)
+            pump_delay = self._pump(now)
+            return max(0.0, min(delay, pump_delay))
 
     def _record_view(self, now: float) -> None:
         """One merged fleet-wide capacity/health view entry (shards have
@@ -562,41 +595,49 @@ class ShardedDispatcher:
         self._view_gen = gen
 
     def _step_score(self, now: float) -> float:
-        spans = []
-        for sh in self.shards:
-            with sh._cond:
+        # The whole pass runs under ALL shard locks (ascending — the
+        # total-order discipline): the global placer filters, scores and
+        # reserves on EVERY shard's engine and re-homes records across
+        # shards, so holding only the home shard's lock would race the
+        # submit/delete/resync handler threads mutating foreign engines
+        # under their own locks.  Score route is the shadow-safe
+        # migration mode — it trades the per-shard parallelism the cell
+        # route keeps for exact global placement parity, so fleet-wide
+        # serialization here is the contract, not a regression.
+        with self.lock:
+            for sh in self.shards:
                 span = sh.prof_phases.span()
                 sh._pre_pass(now, span)
                 span.close("queue-poll")
-        # global drain: across shards, always take THE queue_less-least
-        # ready pod next — the same processing order the single-lock
-        # _drain_ready derives, which is what makes score-route replay
-        # placement-parity exact (doc/sharding.md)
-        progressed = True
-        synced: set[int] = set()
-        while progressed:
-            progressed = False
-            best = None      # (shard, key)
-            for sh in self.shards:
-                with sh._cond:
+            # global drain: across shards, always take THE queue_less-
+            # least ready pod next — the same processing order the
+            # single-lock _drain_ready derives, which is what makes
+            # score-route replay placement-parity exact (doc/sharding.md)
+            progressed = True
+            synced: set[int] = set()
+            while progressed:
+                progressed = False
+                best = None      # (shard, key, pod)
+                for sh in self.shards:
                     key = sh._pick(now)
-                if key is None:
-                    continue
-                if best is None or self._less(sh, key, *best):
-                    best = (sh, key)
-            if best is None:
-                break
-            sh, key = best
-            with sh._cond:
+                    if key is None:
+                        continue
+                    pod = sh._pending.get(key)
+                    if pod is None:
+                        continue
+                    if best is None or self._less(sh, pod,
+                                                  best[0], best[2]):
+                        best = (sh, key, pod)
+                if best is None:
+                    break
+                sh, key, pod = best
                 if sh.shard_id not in synced and sh._sync is not None:
                     try:
                         sh._sync()
                     except Exception as e:
                         log.warning("capacity sync failed: %s", e)
                     synced.add(sh.shard_id)
-                pod = sh._pending.pop(key, None)
-                if pod is None:
-                    continue
+                sh._pending.pop(key, None)
                 sh._retry_at.pop(key, None)
                 span = sh.prof_phases.span()
                 placer = (None if pod.group_name
@@ -607,26 +648,28 @@ class ShardedDispatcher:
                 sh._cycle(pod, now, span, placer=placer)
                 span.close("")
                 progressed = True
-        delay = float("inf")
-        for sh in self.shards:
-            with sh._cond:
+            delay = float("inf")
+            for sh in self.shards:
                 sh._post_pass(now)
                 delay = min(delay, sh._next_delay(now))
         return delay
 
-    def _less(self, sh_a: Dispatcher, key_a: str,
-              sh_b: Dispatcher, key_b: str) -> bool:
-        a, b = sh_a._pending[key_a], sh_b._pending[key_b]
-        return _queue_less(a, sh_a.engine.group_of(a),
-                           b, sh_b.engine.group_of(b))
+    @staticmethod
+    def _less(sh_a: Dispatcher, pod_a: PodRequest,
+              sh_b: Dispatcher, pod_b: PodRequest) -> bool:
+        return _queue_less(pod_a, sh_a.engine.group_of(pod_a),
+                           pod_b, sh_b.engine.group_of(pod_b))
 
     def _global_placer(self, home: Dispatcher):
         """A ``placer`` for :meth:`Dispatcher._cycle` that reproduces
         ``engine.schedule``'s global candidate walk across every shard
         engine — filter all fleet nodes, score, normalize over the full
         candidate set, reserve best-first — then re-homes the pod record
-        onto the shard whose subtree won.  Gang pods never take this
-        path (they pin to their home subtree or the trial-book)."""
+        onto the shard whose subtree won.  The caller must hold ALL
+        shard locks (:meth:`_step_score` drains under ``self.lock``):
+        this touches every shard's engine, not just the home's.  Gang
+        pods never take this path (they pin to their home subtree or
+        the trial-book)."""
 
         def place(pod: PodRequest):
             cand: list[tuple[str, Dispatcher]] = []
@@ -691,7 +734,7 @@ class ShardedDispatcher:
                 log.exception("healthwatch pump failed")
             span.lap("healthwatch")
         if self.healthwatch is not None:
-            delay = min(delay, max(0.0, self.healthwatch._next_poll - now))
+            delay = min(delay, self.healthwatch.seconds_until_due(now))
         if self.slo is not None:
             try:
                 self.slo.evaluate(now)
