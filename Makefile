@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay bench-shard image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-rightsize bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay bench-shard image clean obs-check
 
 all: native
 
@@ -82,6 +82,16 @@ bench-health:
 bench-autopilot:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_autopilot.py \
 		--baseline bench_autopilot.json --write bench_autopilot.json
+
+# Rightsizer bench (doc/autopilot.md, Rightsizing): the seeded churn
+# scenario with the SLO-driven capacity controller in the loop vs the
+# static declared shares; --check gates the every-SLO-met,
+# zero-new-alerts, >=30% chip-equivalent reduction, zero-rollback and
+# disabled-controller replay-clean bars, then refreshes
+# bench_rightsize.json.
+bench-rightsize:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_rightsize.py --check \
+		--baseline bench_rightsize.json --write bench_rightsize.json
 
 # SLO-plane micro-bench (doc/observability.md): evaluator cost per
 # observation, exemplar surcharge, and burn-to-alert detection latency

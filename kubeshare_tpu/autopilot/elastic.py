@@ -55,6 +55,12 @@ _REVOKES = _OBS.counter(
     "kubeshare_autopilot_credit_revocations_total",
     "Burst-credit revocations by trigger.",
     labels=("reason",))
+_SKIPPED = _OBS.counter(
+    "kubeshare_elastic_skipped_total",
+    "Elastic lending cycles that granted nothing, by reason — "
+    "\"no-set-effective\" means the chip's native token core predates "
+    "effective shares and lending is inert on it.",
+    labels=("reason",))
 
 
 @dataclass
@@ -271,7 +277,13 @@ class ElasticQuota:
                      min(req + grant, new_limit), new_limit))
             elif not sched.set_effective(name, min(req + grant, new_limit),
                                          new_limit):
-                return summary   # core predates set_effective: no credit
+                # core predates set_effective: no credit was (or can be)
+                # granted on this chip — count it so inert lending shows
+                # up on a dashboard instead of silently doing nothing
+                _SKIPPED.inc("no-set-effective")
+                log.warning("chip %s: token core predates set_effective; "
+                            "elastic lending is inert here", chip)
+                return summary
             credits[name] = _Credit(amount=grant, lenders=set(headroom),
                                     since_ms=now, gang=gang)
             _CREDIT.set(chip, name, value=grant)

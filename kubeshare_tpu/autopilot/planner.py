@@ -113,6 +113,12 @@ class Planner:
         since = self._last_moved.get(key)
         return since is not None and (now - since) < self.cooldown_s
 
+    def cooling(self, key: str, now: float | None = None) -> bool:
+        """Public cooldown probe — the rightsizer shares this rail so a
+        just-moved pod is not immediately resized and a just-resized pod
+        is not immediately moved (doc/autopilot.md, Rightsizing)."""
+        return self._cooling(key, self._clock() if now is None else now)
+
     # -- candidate selection --------------------------------------------
 
     def _candidates(self, eng) -> list:

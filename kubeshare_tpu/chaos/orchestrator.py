@@ -134,6 +134,8 @@ class ChaosRunner:
         from ..obs.ledger import ChipTimeLedger
 
         self.autopilot = None
+        self.rightsizer = None       # built lazily on rightsize_apply
+        self._synth_end: dict = {}   # synthetic ledger chip -> last end
         self.preempt = None          # PreemptionPolicy once preempt_on
         self.token_scheds: dict = {}
         # per-run chip-time ledger on the virtual clock: every mirrored
@@ -179,10 +181,11 @@ class ChaosRunner:
         p = act.params
         if act.action == "submit":
             prefix = p.get("prefix", "pod")
+            ns = p.get("namespace", "chaos")
             labels = {C.POD_TPU_REQUEST: str(p.get("request", 0.5)),
                       C.POD_TPU_LIMIT: "1.0"}
             for i in range(int(p.get("count", 1))):
-                self.disp.submit("chaos", f"{prefix}{i}", dict(labels))
+                self.disp.submit(ns, f"{prefix}{i}", dict(labels))
         elif act.action == "submit_gang":
             labels = {C.POD_TPU_REQUEST: str(p.get("request", 0.5)),
                       C.POD_TPU_LIMIT: "1.0",
@@ -231,6 +234,13 @@ class ChaosRunner:
                 p.get("duration_s", 1.0))
         elif act.action == "autopilot_apply":
             self._autopilot_cycle()
+        elif act.action == "ledger_idle":
+            self._ledger_idle(act.target or "chaos",
+                              duration_s=float(p.get("duration_s", 4.0)),
+                              active_frac=float(
+                                  p.get("active_frac", 0.1)))
+        elif act.action == "rightsize_apply":
+            self._rightsize_cycle()
         elif act.action == "preempt_on":
             from ..preempt import PreemptionPolicy
 
@@ -286,6 +296,52 @@ class ChaosRunner:
                                        rebalancer=reb,
                                        clock=self._clock)
         self.autopilot.cycle(now=self.now)
+
+    def _ledger_idle(self, namespace: str, duration_s: float,
+                     active_frac: float) -> None:
+        """Feed the chip-time ledger a synthetic, mostly-idle grant
+        window for every bound pod in *namespace* — the rightsizer's
+        sustained granted-idle shrink signal, manufactured at virtual
+        speed. Slices land on per-pod synthetic chips so the mirrored
+        TokenSchedulers' real ledger feeds stay untouched and per-chip
+        conservation keeps holding."""
+        with self.disp.lock:
+            keys = sorted(k for k, pod in self.engine.pod_status.items()
+                          if k.startswith(namespace + "/")
+                          and pod.node_name)
+        for key in keys:
+            chip = f"synthetic::{key}"
+            start = max(self.now - duration_s,
+                        self._synth_end.get(chip, 0.0))
+            if self.now - start <= 0.0:
+                continue
+            self.ledger.grant(chip, key, tpu_class="latency", now=start)
+            active = (self.now - start) * max(0.0, min(active_frac, 1.0))
+            if active > 0.0:
+                self.ledger.execute_begin(chip, now=start)
+                self.ledger.execute_end(chip, now=start + active)
+            self.ledger.release(chip, now=self.now)
+            self._synth_end[chip] = self.now
+
+    def _rightsize_cycle(self) -> None:
+        if self.rightsizer is None:
+            from ..rightsize import RightsizeConfig, Rightsizer
+
+            # chaos-speed rails: the nemesis runs in seconds, not the
+            # production 10-minute observation windows
+            cfg = RightsizeConfig(window_s=4.0, cooldown_s=0.2,
+                                  idle_frac=0.5, min_coverage=0.25,
+                                  min_delta=0.04, pack_util=0.35,
+                                  pack_cooldown_s=1.0)
+            self.rightsizer = Rightsizer(
+                self.disp, ledger=self.ledger,
+                schedulers=self.token_scheds,
+                gang_coordinator=self.gangcoord, cfg=cfg,
+                journal_path=os.path.join(self.workdir,
+                                          "rightsize.jsonl"),
+                clock=self._clock)
+        self._sync_token_scheds()
+        self.rightsizer.cycle(now=self.now)
 
     def _serve_submit(self, tenant: str, count: int) -> None:
         import numpy as np

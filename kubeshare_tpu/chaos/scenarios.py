@@ -28,6 +28,13 @@ Action vocabulary (executed by ``orchestrator.ChaosRunner``):
                       duration_s) — binding publishes must roll back
 ``autopilot_apply``   run one plan+apply cycle (races whatever else is
                       in the window)
+``ledger_idle``       feed the chip-time ledger a synthetic mostly-idle
+                      grant window for a namespace's bound pods — the
+                      rightsizer's shrink signal at virtual speed
+                      (params: duration_s, active_frac)
+``rightsize_apply``   run one rightsizer plan+apply cycle (shrinks,
+                      rollback rails, pack moves — doc/autopilot.md,
+                      Rightsizing)
 ``serve_submit``      admit serving requests (params: tenant, count)
 ``park`` / ``resume`` freeze a serving tenant into a manifest / replay it
 ``servable_crash``    the shared servable raises for the window (params:
@@ -289,6 +296,39 @@ def cross_shard_gang_commit_fail(seed: int) -> Scenario:
         ])
 
 
+def resize_mid_eviction(seed: int) -> Scenario:
+    """The rightsizer's shrink batch (sustained granted-idle ledger
+    signal) races a node eviction — the resize re-booking, the
+    whole-plan rollback rail and the eviction/rebind path must never
+    tear a booking, double-book a chip, or push a chip's effective
+    token sum past 1.0; a second cycle then plans against the
+    half-evicted cluster and must stay inert or consistent."""
+    r = _rng("resize-mid-eviction", seed)
+    rz_at = _j(r, 4.2)
+    return Scenario(
+        "resize-mid-eviction",
+        "rightsize shrink batch racing a node eviction",
+        [
+            ChaosAction(0.0, "submit",
+                        params={"count": 6, "request": 0.6,
+                                "namespace": "rz"}),
+            # manufacture the sustained granted-idle window the shrink
+            # signal needs (real ledger account rows, synthetic chips)
+            ChaosAction(_j(r, 4.0, 0.1), "ledger_idle", "rz",
+                        {"duration_s": 4.0, "active_frac": 0.1}),
+            ChaosAction(rz_at, "rightsize_apply"),
+            ChaosAction(_j(r, rz_at + 0.05, 0.1), "node_down",
+                        "host-1"),
+            ChaosAction(_j(r, rz_at + 1.0), "ledger_idle", "rz",
+                        {"duration_s": 1.0, "active_frac": 0.1}),
+            # the shrink-spacing rail inhibits a second shrink this
+            # close; the cycle still plans (and may pack) against the
+            # half-evicted cluster
+            ChaosAction(_j(r, rz_at + 1.5), "rightsize_apply"),
+            ChaosAction(_j(r, rz_at + 4.0), "node_up", "host-1"),
+        ])
+
+
 BUILDERS = {
     "node-crash-flap": node_crash_flap,
     "registry-restart-mid-lease": registry_restart_mid_lease,
@@ -299,6 +339,7 @@ BUILDERS = {
     "gang-grant-vs-eviction": gang_grant_vs_eviction,
     "preemption-vs-migration": preemption_vs_migration,
     "cross-shard-gang-commit-fail": cross_shard_gang_commit_fail,
+    "resize-mid-eviction": resize_mid_eviction,
 }
 
 

@@ -211,6 +211,47 @@ def check_autopilot(addr: str, timeout_s: float,
         f"{state.get('rolled_back_total', 0)} rolled back")
 
 
+def check_rightsize(addr: str, timeout_s: float,
+                    defaulted: bool = False) -> bool:
+    """Rightsizer probe (doc/autopilot.md, Rightsizing): ``/rightsize``
+    must answer; a detached rightsizer is a skip (opt-in via
+    ``--rightsize``). An attached one fails on rollbacks outnumbering
+    applies — the controller is thrashing against a fleet that keeps
+    refusing its plans — and reports burn/share state otherwise."""
+    if not addr or addr == "none":
+        return _result("rightsize", "skip", "--scheduler none")
+    try:
+        state = json.loads(_get(f"http://{addr}/rightsize", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("rightsize", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("rightsize", "skip",
+                           "scheduler predates /rightsize")
+        return _result("rightsize", "fail", f"{addr}: {exc}")
+    if not state.get("attached"):
+        return _result("rightsize", "skip",
+                       "not attached (start the scheduler with "
+                       "--rightsize to enable)")
+    applied = state.get("applied_total", 0)
+    rolled = state.get("rolled_back_total", 0)
+    if rolled > max(applied, 0):
+        return _result(
+            "rightsize", "fail",
+            f"{rolled} rollback(s) vs {applied} applied — the "
+            "controller is thrashing (see the resize journal)")
+    eq = state.get("chip_equivalents") or {}
+    return _result(
+        "rightsize", "ok",
+        f"{addr}: {'enabled' if state.get('enabled') else 'DISABLED'}, "
+        f"{state.get('cycles', 0)} cycle(s), {applied} applied / "
+        f"{rolled} rolled back, chip-equivalents "
+        f"{eq.get('current', 0.0):g}/{eq.get('declared', 0.0):g} "
+        "booked/declared")
+
+
 def check_serving(addr: str, timeout_s: float,
                   defaulted: bool = False) -> bool:
     """Serving-plane probe (doc/serving.md): ``/serving`` must answer;
@@ -702,6 +743,7 @@ def main(argv=None) -> int:
     ok &= check_fleet(registry, 5.0, defaulted=reg_defaulted)
     ok &= check_scheduler(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_autopilot(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_rightsize(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_serving(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_slo(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_invariants(scheduler, 5.0, defaulted=sched_defaulted)

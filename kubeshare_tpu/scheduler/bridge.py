@@ -259,6 +259,15 @@ class ServiceClient:
             raise RuntimeError(f"/autopilot returned {code}")
         return body
 
+    def rightsize(self) -> dict:
+        """Capacity-rightsizer snapshot (``GET /rightsize``,
+        doc/autopilot.md Rightsizing); ``{"attached": false}`` when the
+        plane is off, RuntimeError when the scheduler predates it."""
+        code, body = self._call("GET", "/rightsize")
+        if code != 200:
+            raise RuntimeError(f"/rightsize returned {code}")
+        return body
+
     def serving(self) -> dict:
         """Serving front-door join view (``GET /serving``,
         doc/serving.md); ``{"attached": false}`` when no front door is

@@ -976,6 +976,70 @@ class Dispatcher:
                     f"{key}: move {source} -> {node} failed "
                     f"({move_err}); source restored") from move_err
 
+    def resize_request(self, key: str, new_request: float) -> dict:
+        """Re-book a bound fractional pod's compute share in place — the
+        executor for an accepted rightsize plan (doc/autopilot.md,
+        Rightsizing). The pod keeps its chip and port; the compute
+        fraction booked on the leaf (and every ancestor) moves, and an
+        HBM cap that was *defaulted* from the compute fraction rescales
+        with it (an explicitly declared cap is kept — the tenant asked
+        for that much memory regardless of share), so the chaos
+        oracle's booking-conservation invariant holds by construction.
+        Grows are bounded by the leaf's free capacity — a grow that
+        does not fit raises :class:`Unschedulable` and nothing changes
+        (the rightsizer migrates a neighbour away and retries on a
+        later cycle). Returns ``{"pod", "chip", "from", "to"}``
+        describing what was re-booked."""
+        with self._cond:
+            now = self._clock()
+            pod = self.engine.pod_status.get(key)
+            if pod is None or not pod.node_name:
+                raise Unschedulable(f"{key}: not a bound pod")
+            if not pod.needs_tpu or pod.multi_chip or not pod.bookings:
+                raise Unschedulable(
+                    f"{key}: only fractional single-chip pods resize")
+            if not (0.0 < new_request <= 1.0):
+                raise Unschedulable(
+                    f"{key}: resize target {new_request} out of (0, 1]")
+            chip_id, old_request, memory = pod.bookings[0]
+            if abs(new_request - old_request) <= 1e-9:
+                return {"pod": key, "chip": chip_id,
+                        "from": old_request, "to": old_request}
+            cell = self.engine.leaf_cells.get(chip_id)
+            if cell is None:
+                raise Unschedulable(f"{key}: booked chip {chip_id} gone")
+            grow = new_request - old_request
+            if grow > 0 and cell.available + 1e-9 < grow:
+                raise Unschedulable(
+                    f"{key}: chip {chip_id} has {cell.available:.3f} "
+                    f"free, grow needs {grow:.3f}")
+            # HBM: a cap defaulted from the compute fraction
+            # (engine.reserve, pod.go:419-424) tracks the new fraction;
+            # an explicit cap is the tenant's own number and stays
+            if memory == int(math.floor(old_request * cell.full_memory)):
+                new_memory = int(
+                    math.floor(new_request * cell.full_memory))
+            else:
+                new_memory = memory
+            mem_grow = new_memory - memory
+            if mem_grow > 0 and cell.free_memory < mem_grow:
+                raise Unschedulable(
+                    f"{key}: chip {chip_id} has {cell.free_memory} "
+                    f"HBM free, grow needs {mem_grow}")
+            reclaim_resource(cell, old_request, memory)
+            reserve_resource(cell, new_request, new_memory)
+            pod.bookings[0] = (chip_id, new_request, new_memory)
+            pod.request = new_request
+            pod.memory = new_memory
+            pod.limit = max(pod.limit, new_request)
+            self.engine.alloc_gen += 1
+            if self.decisions is not None:
+                self.decisions.record("resize", now, pod=key, chip=chip_id,
+                                      src=old_request, dst=new_request)
+            self._cond.notify_all()   # freed share may unblock a waiter
+            return {"pod": key, "chip": chip_id,
+                    "from": old_request, "to": new_request}
+
     def _rebind_locked(self, pod: PodRequest, node: str) -> Binding:
         """Reserve + publish + resolve for an in-place move (caller holds
         the lock and has already unreserved). Publish failure rolls the

@@ -25,6 +25,11 @@ API (JSON):
   (doc/autopilot.md; ``{"attached": false}`` when the plane is off)
 - ``POST /autopilot/plan``   dry-run: emit a migration plan, touch nothing
 - ``POST /autopilot/apply``  plan + execute one cycle (409 when detached)
+- ``GET  /rightsize`` SLO-driven capacity rightsizer state: per-tenant
+  burn vs budget, current/proposed shares, chip-equivalents
+  (doc/autopilot.md, Rightsizing; ``{"attached": false}`` when off)
+- ``POST /rightsize/plan``   dry-run: emit a resize plan, touch nothing
+- ``POST /rightsize/apply``  plan + execute one cycle (409 when detached)
 - ``GET  /serving``   serving front-door join view: per-tenant queues,
   admit/shed totals, batch stats (doc/serving.md; ``{"attached":
   false}`` when no front door is wired)
@@ -149,6 +154,7 @@ class SchedulerService:
         self._replay = replay
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
+        self.rightsizer = None
         self.serving = None
         self.remote_write = None
 
@@ -175,6 +181,13 @@ class SchedulerService:
         ``self.dispatcher`` (doc/autopilot.md); exposes it on
         ``/autopilot``."""
         self.autopilot = autopilot
+        return self
+
+    def attach_rightsize(self, rightsizer) -> "SchedulerService":
+        """Wire a :class:`~..rightsize.Rightsizer` built over
+        ``self.dispatcher`` (doc/autopilot.md, Rightsizing); exposes it
+        on ``/rightsize``."""
+        self.rightsizer = rightsizer
         return self
 
     def attach_serving(self, frontdoor) -> "SchedulerService":
@@ -253,6 +266,12 @@ class SchedulerService:
         if self.autopilot is None:
             return {"attached": False, "enabled": False}
         return self.autopilot.snapshot()
+
+    def rightsize_state(self) -> dict:
+        """``GET /rightsize`` body; cheap when no rightsizer is wired."""
+        if self.rightsizer is None:
+            return {"attached": False, "enabled": False}
+        return self.rightsizer.snapshot()
 
     def serving_state(self) -> dict:
         """``GET /serving`` body; cheap when no front door is wired."""
@@ -421,6 +440,8 @@ class SchedulerService:
                     return self._reply(200, svc.health())
                 if self.path == "/autopilot":
                     return self._reply(200, svc.autopilot_state())
+                if self.path == "/rightsize":
+                    return self._reply(200, svc.rightsize_state())
                 if self.path == "/serving":
                     return self._reply(200, svc.serving_state())
                 if self.path == "/slo":
@@ -474,6 +495,17 @@ class SchedulerService:
                             return self._reply(
                                 409, {"error": "autopilot not attached"})
                         return self._reply(200, svc.autopilot.cycle())
+                    if self.path == "/rightsize/plan":
+                        if svc.rightsizer is None:
+                            return self._reply(
+                                409, {"error": "rightsizer not attached"})
+                        return self._reply(
+                            200, {"plan": svc.rightsizer.plan()})
+                    if self.path == "/rightsize/apply":
+                        if svc.rightsizer is None:
+                            return self._reply(
+                                409, {"error": "rightsizer not attached"})
+                        return self._reply(200, svc.rightsizer.cycle())
                 except (LabelError, Unschedulable) as e:
                     return self._reply(409, {"error": str(e)})
                 except Exception as e:
@@ -570,6 +602,13 @@ def main(argv=None) -> None:
     parser.add_argument("--autopilot-journal", default="",
                         help="JSONL move journal path (crash-safe batch "
                              "recovery); empty = no journal")
+    parser.add_argument("--rightsize", action="store_true",
+                        help="attach the SLO-driven capacity rightsizer: "
+                             "/rightsize snapshot + plan/apply endpoints "
+                             "(doc/autopilot.md, Rightsizing)")
+    parser.add_argument("--rightsize-journal", default="",
+                        help="JSONL resize journal path; empty = no "
+                             "journal")
     parser.add_argument("--flight-dump-dir", default="",
                         help="persist flight-recorder black-box dumps as "
                              "JSONL files here (in-memory only when empty)")
@@ -618,16 +657,31 @@ def main(argv=None) -> None:
                      if args.health else None),
         shards=args.shards, shard_route=args.shard_route,
         max_pending=args.max_pending or None)
-    if args.autopilot:
-        from ..autopilot import Autopilot, Planner, Rebalancer
+    planner = rebalancer = None
+    if args.autopilot or args.rightsize:
+        # the cooldown rail is SHARED: a pod the autopilot just moved
+        # must not be immediately resized, and vice versa — one planner
+        # (and one journaled rebalancer) backs both planes
+        from ..autopilot import Planner, Rebalancer
 
         planner = Planner(svc.dispatcher, budget=args.autopilot_budget)
+        rebalancer = Rebalancer(svc.dispatcher, planner=planner,
+                                journal_path=(args.autopilot_journal
+                                              or None),
+                                gang_coordinator=svc.gangcoord)
+    if args.autopilot:
+        from ..autopilot import Autopilot
+
         svc.attach_autopilot(Autopilot(
-            svc.dispatcher, planner=planner,
-            rebalancer=Rebalancer(svc.dispatcher, planner=planner,
-                                  journal_path=(args.autopilot_journal
-                                                or None),
-                                  gang_coordinator=svc.gangcoord)))
+            svc.dispatcher, planner=planner, rebalancer=rebalancer))
+    if args.rightsize:
+        from ..rightsize import Rightsizer
+
+        svc.attach_rightsize(Rightsizer(
+            svc.dispatcher, slo=svc.slo, ledger=svc.ledger,
+            blame=svc.blame, planner=planner, rebalancer=rebalancer,
+            gang_coordinator=svc.gangcoord,
+            journal_path=(args.rightsize_journal or None)))
     if args.preempt:
         from ..preempt import PreemptionPolicy
 

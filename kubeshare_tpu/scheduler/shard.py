@@ -494,6 +494,33 @@ class ShardedDispatcher:
             out.extend(sh.replay_bound())
         return out
 
+    # -- rebalance/rightsize surface (doc/autopilot.md) ----------------
+    # Moves and resizes run on the shard whose ENGINE holds the pod's
+    # bookings: a shard's migration plan only ever proposes destinations
+    # its own engine scores, so the booking mutation stays under one
+    # shard lock and the per-shard oracle invariants keep holding.
+
+    def plan_migration(self, key: str, exclude=()) -> dict | None:
+        sh = self._engine_owner(key)
+        return None if sh is None else sh.plan_migration(key, exclude)
+
+    def apply_move(self, key: str, node: str):
+        sh = self._engine_owner(key)
+        if sh is None:
+            raise Unschedulable(f"{key}: not a bound pod")
+        if self.plan.shard_of(node) != sh.shard_id:
+            raise Unschedulable(
+                f"{key}: {node} lives on shard "
+                f"{self.plan.shard_of(node)}, bookings on {sh.shard_id}; "
+                "cross-shard moves go through the submit path")
+        return sh.apply_move(key, node)
+
+    def resize_request(self, key: str, new_request: float) -> dict:
+        sh = self._engine_owner(key)
+        if sh is None:
+            raise Unschedulable(f"{key}: not a bound pod")
+        return sh.resize_request(key, new_request)
+
     # -- aggregate state (drive()/service surface) ---------------------
 
     @property

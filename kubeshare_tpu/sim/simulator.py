@@ -814,6 +814,24 @@ def main(argv=None) -> None:
                              "machine-readable report: wait percentiles, "
                              "ranked blame, ledger conservation — "
                              "deterministic per --seed")
+    parser.add_argument("--rightsize", action="store_true",
+                        help="run the seeded tenant-churn scenario "
+                             "(kubeshare_tpu/rightsize, doc/autopilot."
+                             "md) in virtual time with the SLO-driven "
+                             "capacity rightsizer closing the loop and "
+                             "print the machine-readable report: "
+                             "chip-equivalents vs declared, resize/"
+                             "pack timelines, alert sets, ledger "
+                             "conservation — deterministic per --seed")
+    parser.add_argument("--rightsize-static", action="store_true",
+                        help="with --rightsize: keep the controller "
+                             "attached but disabled — the static "
+                             "baseline the bench compares against "
+                             "(its decision stream must stay empty)")
+    parser.add_argument("--rightsize-horizon", type=float,
+                        default=3600.0, metavar="S",
+                        help="with --rightsize: virtual seconds to "
+                             "simulate (default 3600)")
     parser.add_argument("--chaos", action="store_true",
                         help="run the deterministic chaos-scenario "
                              "suite (kubeshare_tpu/chaos, doc/chaos.md) "
@@ -825,8 +843,8 @@ def main(argv=None) -> None:
                         help="with --chaos: run only NAME (repeatable; "
                              "default: every scenario)")
     parser.add_argument("--shards", type=int, default=1, metavar="N",
-                        help="with --chaos: run the nemesis against an "
-                             "N-shard cell-route dispatcher plane "
+                        help="with --chaos or --rightsize: run against "
+                             "an N-shard cell-route dispatcher plane "
                              "(doc/sharding.md) with cross-shard "
                              "invariants sampled; 1 = the single-lock "
                              "scheduler (default)")
@@ -839,10 +857,23 @@ def main(argv=None) -> None:
 
     if sum(map(bool, (args.synthetic, args.trace, args.churn,
                       args.serve, args.critpath, args.chaos,
-                      args.contention))) != 1:
+                      args.contention, args.rightsize))) != 1:
         parser.error("exactly one of --trace / --synthetic / --churn "
                      "/ --serve / --critpath / --chaos / --contention "
-                     "is required")
+                     "/ --rightsize is required")
+    if args.rightsize:
+        from ..rightsize import simulate_rightsize
+
+        hosts = len({chip.host
+                     for chip in parse_fake_spec(args.topology).chips()})
+        out = simulate_rightsize(seed=args.seed, hosts=hosts,
+                                 shards=args.shards,
+                                 horizon_s=args.rightsize_horizon,
+                                 rightsize=not args.rightsize_static)
+        print(json.dumps({"rightsize": out}, sort_keys=True))
+        return
+    if args.rightsize_static:
+        parser.error("--rightsize-static only applies to --rightsize")
     if args.contention:
         out = simulate_contention(args.contention, seed=args.seed,
                                   preempt=args.preempt)
@@ -857,7 +888,8 @@ def main(argv=None) -> None:
         print(json.dumps({"chaos": out}, sort_keys=True))
         return
     if args.shards != 1:
-        parser.error("--shards only applies to --chaos (the virtual-"
+        parser.error("--shards only applies to --chaos and --rightsize "
+                     "(the virtual-"
                      "time sim loop drives the engine directly; the "
                      "sharded plane lives behind the Dispatcher — see "
                      "doc/sharding.md)")

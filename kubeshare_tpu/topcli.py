@@ -12,8 +12,9 @@ Usage::
     python -m kubeshare_tpu.topcli [--registry HOST:PORT] [--node N]
                                    [--scheduler HOST:PORT]
                                    [--watch SECONDS] [--json] [--latency]
-                                   [--health] [--autopilot] [--serving]
-                                   [--gangs] [--fleet] [--why TARGET]
+                                   [--health] [--autopilot] [--rightsize]
+                                   [--serving] [--gangs] [--fleet]
+                                   [--why TARGET]
                                    [--critpath --spans PATH ...]
 
 One-shot by default (script-friendly); ``--watch`` refreshes in place.
@@ -44,6 +45,10 @@ the scheduler's ``/health`` (state machine, shed/evicted totals).
 (``doc/autopilot.md``): cluster fragmentation score, pending/applied
 moves and per-chip burst credits from the scheduler's ``/autopilot``,
 joined with the registry's capacity and lease views.
+``--rightsize`` renders the capacity rightsizer (``doc/autopilot.md``,
+Rightsizing): per-tenant SLO burn vs budget, current/proposed/declared
+share and the controller's decision reason from the scheduler's
+``/rightsize``, plus pending resizes and pack moves.
 ``--serving`` renders the inference front door (``doc/serving.md``):
 per-tenant queue depth, admit/shed totals and request p50/p99 from the
 scheduler's ``/serving``, joined with the registry's capacity view.
@@ -302,6 +307,87 @@ def render_autopilot(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def rightsize_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Rightsizer join (doc/autopilot.md, Rightsizing): the scheduler's
+    ``/rightsize`` state — per-tenant burn vs budget, current/proposed
+    share, decision reason — over the registry's capacity view, so the
+    share the controller wants and the chips it would free are one
+    frame."""
+    state: dict = {}
+    if scheduler is not None:
+        try:
+            state = scheduler.rightsize()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "rightsize state unavailable, showing capacity only",
+                  file=sys.stderr)
+    chips = 0
+    booked_total = 0.0
+    try:
+        capacity = client.capacity()
+        pods = client.pods()
+        chips = sum(len(e.get("chips", [])) for e in capacity.values())
+        booked_total = sum(min(float(r.get("request", 0) or 0), 1.0)
+                           for r in pods.values())
+    except Exception:
+        pass
+    return {"rightsize": state or {"attached": False, "enabled": False},
+            "chips": chips, "booked_total": round(booked_total, 3)}
+
+
+def render_rightsize(snap: dict) -> str:
+    rz = snap["rightsize"]
+    lines = ["RIGHTSIZE (SLO-driven capacity rightsizer, "
+             "doc/autopilot.md)"]
+    if not rz.get("attached"):
+        lines.append("  not attached — start the scheduler with "
+                     "--rightsize (or attach_rightsize)")
+        if snap.get("chips"):
+            lines.append(f"  fleet: {snap['chips']} chips, "
+                         f"{snap['booked_total']} chip-equivalents "
+                         "booked (static)")
+        return "\n".join(lines)
+    eq = rz.get("chip_equivalents") or {}
+    lines.append(
+        f"  {'enabled' if rz.get('enabled') else 'DISABLED'}  "
+        f"cycles {rz.get('cycles', 0)}  resizes: "
+        f"{rz.get('applied_total', 0)} applied, "
+        f"{rz.get('rolled_back_total', 0)} rolled back")
+    if eq:
+        lines.append(
+            f"  chip-equivalents: declared {eq.get('declared', 0.0):g}  "
+            f"booked {eq.get('current', 0.0):g}  "
+            f"proposed {eq.get('proposed', 0.0):g}")
+    tenants = rz.get("tenants") or {}
+    if tenants:
+        lines.append(
+            f"  {'tenant':<20} {'share':>7} {'proposed':>9} "
+            f"{'declared':>9} {'burn f/s':>12} {'budget':>7} "
+            f"{'idle':>5}  reason")
+        for name in sorted(tenants):
+            t = tenants[name]
+            burn = (f"{t.get('burn_fast', 0.0):.1f}/"
+                    f"{t.get('burn_slow', 0.0):.1f}")
+            flag = "!" if t.get("firing") else " "
+            lines.append(
+                f" {flag}{name:<20} {t.get('share', 0.0):>7g} "
+                f"{t.get('proposed', 0.0):>9g} "
+                f"{t.get('declared', 0.0):>9g} {burn:>12} "
+                f"{t.get('budget_remaining', 1.0):>7.2f} "
+                f"{t.get('idle_frac', 0.0):>5.2f}  "
+                f"{t.get('reason') or '-'}")
+    for r in rz.get("pending_resizes", []):
+        lines.append(
+            f"  plan: {r.get('pod')}  {r.get('from'):g} -> "
+            f"{r.get('to'):g}  [{r.get('direction')}: "
+            f"{r.get('reason')}]"
+            + (f"  (gang {r['gang']})" if r.get("gang") else ""))
+    for mv in rz.get("pending_moves", []):
+        lines.append(f"  pack: {mv.get('pod')}  {mv.get('from')} -> "
+                     f"{mv.get('node')}")
+    return "\n".join(lines)
+
+
 def serving_snapshot(client: RegistryClient, scheduler=None) -> dict:
     """Serving join (doc/serving.md): the scheduler's ``/serving`` view
     (per-tenant queue depth, admit/shed totals, p50/p99) over the
@@ -405,6 +491,18 @@ FLEET_PREEMPT_PANELS = (
      "quantile", 0.99, "chip", "s"),
     ("boosts", "kubeshare_preempt_boost_grants_total",
      "increase", None, "chip", ""),
+)
+
+#: (label, family, agg, q, group_label, unit) — the --fleet RIGHTSIZE
+#: panel (the rightsizer's metric families, doc/autopilot.md: live
+#: chip-equivalents by view, per-tenant slow burn, resize dispositions)
+FLEET_RIGHTSIZE_PANELS = (
+    ("chip-equiv", "kubeshare_rightsize_chip_equivalents",
+     "latest", None, "view", ""),
+    ("burn slow", "kubeshare_rightsize_burn_slow",
+     "latest", None, "tenant", ""),
+    ("resizes", "kubeshare_rightsize_resizes_total",
+     "increase", None, "outcome", ""),
 )
 
 #: (label, family, agg, q, group_label, unit) — the --fleet LOCKS panel
@@ -782,6 +880,23 @@ def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
         for g in res.get("groups", []):
             gid = g["labels"].get(group, "")
             preempt.setdefault(gid, {})[label] = g["value"]
+    # RIGHTSIZE panel (doc/autopilot.md, Rightsizing): chip-equivalents
+    # by view, per-tenant slow burn, resize dispositions — each row a
+    # (label, group-key, value) triple since the group label varies
+    rightsize: list[dict] = []
+    for label, family, agg, q, group, unit in FLEET_RIGHTSIZE_PANELS:
+        try:
+            res = client.query(family, agg=agg, window_s=window_s,
+                               q=q if q is not None else 0.99,
+                               by=(group,))
+        except Exception:
+            continue          # plane not pushing yet; the table stands
+        for g in res.get("groups", []):
+            if g["value"] is None:
+                continue
+            rightsize.append({"label": label,
+                              "key": g["labels"].get(group, ""),
+                              "value": g["value"]})
     # LOCKS panel (doc/observability.md "Locks, phases, and profiles"):
     # tracked-lock wait rate / hold p99 / contended count per lock name
     locks: dict[str, dict] = {}
@@ -813,7 +928,7 @@ def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
             "window_s": float(window_s),
             "instances": instances, "panels": panels,
             "gangs": gangs, "preempt": preempt, "locks": locks,
-            "contention": contention}
+            "rightsize": rightsize, "contention": contention}
 
 
 def fleet_history(client: RegistryClient, watch_s: float,
@@ -895,6 +1010,21 @@ def render_fleet(snap: dict) -> str:
                 f"{p.get('preempts') if p.get('preempts') is not None else '-':>9} "
                 f"{_fmt_seconds(yld) if yld is not None else '-':>10} "
                 f"{p.get('boosts') if p.get('boosts') is not None else '-':>7}")
+    rightsize = snap.get("rightsize") or []
+    if rightsize:
+        lines.append("RIGHTSIZE (SLO-driven capacity rightsizer, "
+                     "doc/autopilot.md — topcli --rightsize drills in)")
+        by_label: dict[str, list] = {}
+        for row in rightsize:
+            by_label.setdefault(row["label"], []).append(row)
+        for label in ("chip-equiv", "burn slow", "resizes"):
+            rows = by_label.get(label)
+            if not rows:
+                continue
+            cells = "  ".join(
+                f"{r['key']} {r['value']:g}"
+                for r in sorted(rows, key=lambda r: r["key"]))
+            lines.append(f"  {label:<16} {cells}")
     locks = snap.get("locks") or {}
     if locks:
         lines.append("LOCKS (tracked-lock contention, "
@@ -1200,6 +1330,12 @@ def main(argv=None) -> int:
                              "and per-chip burst credits (needs "
                              "--scheduler for autopilot state) instead "
                              "of the fleet table")
+    parser.add_argument("--rightsize", action="store_true",
+                        help="SLO-driven capacity rightsizer join: "
+                             "per-tenant burn vs budget, current/"
+                             "proposed share and decision reason (needs "
+                             "--scheduler for /rightsize state) instead "
+                             "of the fleet table")
     parser.add_argument("--serving", action="store_true",
                         help="serving front-door join: per-tenant queue "
                              "depth, admit/shed rates and p50/p99 (needs "
@@ -1305,6 +1441,10 @@ def main(argv=None) -> int:
                     aps = autopilot_snapshot(client, scheduler)
                     out = (json.dumps(aps) if args.json
                            else render_autopilot(aps))
+                elif args.rightsize:
+                    rzs = rightsize_snapshot(client, scheduler)
+                    out = (json.dumps(rzs) if args.json
+                           else render_rightsize(rzs))
                 elif args.serving:
                     svs = serving_snapshot(client, scheduler)
                     out = (json.dumps(svs) if args.json

@@ -1,0 +1,171 @@
+"""Rightsizer bench: SLO attainment with fewer chips (doc/autopilot.md,
+Rightsizing).
+
+The capacity rightsizer promises one measurable trade: on a fleet of
+mostly over-provisioned tenants it meets **every declared SLO** while
+holding **materially fewer chip-equivalents** than the static declared
+shares — and it does so without inventing alerts, rolling back resizes,
+or perturbing the decision stream when disabled. This bench runs the
+seeded churn scenario (``sim --rightsize``, virtual time) twice — the
+controller in the loop vs the static baseline (attached but disabled) —
+and puts numbers on the gap:
+
+- ``steady_reduction_pct``: steady-state chip-equivalents saved vs the
+  static declared shares (acceptance bar: >= 30%).
+- ``slo_met``: no objective is firing at the end of the rightsized run
+  (the bar; the static run's hot tenants burn forever).
+- ``new_alerts``: (tenant, objective) pairs that fired under
+  rightsizing but NOT under static shares — the bar is zero; growing
+  on burn must never starve someone the static layout kept whole.
+- ``resizes_rolled_back``: whole-plan rollback count (bar: 0).
+- ``ledger_conservation_ok``: the chip-time ledger still partitions
+  every chip's timeline after thousands of resize-adjacent
+  grant/release transitions.
+- ``static_decision_stream_clean``: the disabled controller recorded
+  zero ``rightsize-plan`` / ``rightsize-apply`` / ``resize`` decisions
+  — the replay/shadow plane sees a bit-identical stream (the
+  "disabled => inert" contract the replay diff gates on).
+- ``deterministic``: the rightsized run is byte-identical across two
+  executions with the same seed.
+
+Run: ``python scripts/bench_rightsize.py`` → one JSON object (committed
+as ``bench_rightsize.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers (``make bench-rightsize`` does
+both against ``bench_rightsize.json``). ``--check`` exits non-zero
+unless the acceptance bars hold (the CI ``rightsize-smoke`` job).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line (the rest of the JSON is descriptive)
+_METRICS = ("steady_reduction_pct", "resizes_applied", "moves_applied",
+            "chips_final", "steady_chip_equivalents")
+#: metrics where larger is better (the rest: smaller == tighter fleet)
+_HIGHER_IS_BETTER = ("steady_reduction_pct", "resizes_applied")
+
+#: the seeded scenario — keep in lockstep with tests/test_rightsize.py
+#: and the CI rightsize-smoke step (.github/workflows/ci.yml)
+SEED, HOSTS, HORIZON_S, SHARDS = 7, 2, 3600.0, 1
+
+
+def run_bench() -> dict:
+    from kubeshare_tpu.rightsize import simulate_rightsize
+
+    kw = dict(seed=SEED, hosts=HOSTS, horizon_s=HORIZON_S,
+              shards=SHARDS)
+    sized = simulate_rightsize(rightsize=True, **kw)
+    again = simulate_rightsize(rightsize=True, **kw)
+    static = simulate_rightsize(rightsize=False, **kw)
+
+    sized_alerts = {tuple(a) for a in sized["alerts_firing"]}
+    static_alerts = {tuple(a) for a in static["alerts_firing"]}
+    declared = static["chip_equivalents"]["steady"]
+    steady = sized["chip_equivalents"]["steady"]
+    reduction = 100.0 * (1.0 - steady / declared) if declared else 0.0
+    static_kinds = static["decision_kinds"]
+    return {
+        "bench": "rightsize plane: SLO attainment vs chip-equivalents "
+                 "(seeded churn, virtual clock)",
+        "seed": SEED, "hosts": HOSTS, "horizon_s": HORIZON_S,
+        "shards": SHARDS,
+        "slo_met": sized["slo_met"],
+        "firing_at_end": sized["firing_at_end"],
+        "new_alerts": sorted(map(list, sized_alerts - static_alerts)),
+        "steady_chip_equivalents": steady,
+        "declared_chip_equivalents": declared,
+        "steady_reduction_pct": round(reduction, 1),
+        "chips_start": sized["chips_in_use"]["start"],
+        "chips_final": sized["chips_in_use"]["final"],
+        "resizes_applied": sized["resizes_applied"],
+        "moves_applied": sized["moves_applied"],
+        "resizes_rolled_back": sized["rightsizer"]["rolled_back_total"],
+        "cycles": sized["rightsizer"]["cycles"],
+        "ledger_conservation_ok": sized["ledger_conservation_ok"],
+        "static_decision_stream_clean": not any(
+            k.startswith("rightsize") or k == "resize"
+            for k in static_kinds),
+        "deterministic": json.dumps(sized, sort_keys=True)
+        == json.dumps(again, sort_keys=True),
+    }
+
+
+def check(out: dict) -> int:
+    """The CI rightsize smoke (doc/autopilot.md acceptance bars)."""
+    bars = (
+        ("slo_met", out["slo_met"], "== True", out["slo_met"] is True),
+        ("new_alerts", out["new_alerts"], "== []",
+         out["new_alerts"] == []),
+        ("steady_reduction_pct", out["steady_reduction_pct"],
+         ">= 30", out["steady_reduction_pct"] >= 30.0),
+        ("resizes_rolled_back", out["resizes_rolled_back"],
+         "== 0", out["resizes_rolled_back"] == 0),
+        ("ledger_conservation_ok", out["ledger_conservation_ok"],
+         "== True", out["ledger_conservation_ok"] is True),
+        ("static_decision_stream_clean",
+         out["static_decision_stream_clean"], "== True",
+         out["static_decision_stream_clean"] is True),
+        ("deterministic", out["deterministic"], "== True",
+         out["deterministic"] is True),
+    )
+    failed = 0
+    for name, value, bar, ok in bars:
+        print(f"# {'ok' if ok else 'FAIL'}: {name} = {value} (want {bar})",
+              file=sys.stderr)
+        failed += 0 if ok else 1
+    return 1 if failed else 0
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:30s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:30s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_rightsize")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the SLO/reduction/replay "
+                             "acceptance bars hold (the CI smoke)")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    if args.check:
+        return check(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

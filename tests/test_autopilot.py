@@ -460,6 +460,26 @@ def test_elastic_inert_without_borrowers_or_peers():
     assert sched.effective("other") == (0.3, 0.5)
 
 
+def test_elastic_counts_skip_when_core_predates_set_effective():
+    """A token core without set_effective can't take credits: the step
+    must report the chip inert AND bump the skip counter — not return
+    a summary that claims the window was lent (the old silent-return
+    path left ``lent`` pre-populated)."""
+    from kubeshare_tpu.autopilot import elastic as elastic_mod
+
+    clk, sched, elastic = _hot_pair()
+    sched.set_effective = lambda *a, **kw: False
+    before = elastic_mod._SKIPPED.value("no-set-effective")
+    summary = elastic.step()
+    assert elastic_mod._SKIPPED.value("no-set-effective") == before + 1
+    # no credit was granted anywhere: summary, snapshot and the
+    # scheduler's effective shares all agree nothing happened
+    assert summary["t"]["lent"] == 0.0
+    assert summary["t"]["borrowers"] == []
+    assert elastic.snapshot()["chips"].get("t", {}) == {}
+    assert sched.effective("B") == (0.2, 0.3)
+
+
 # --------------------------------------------------------------------------
 # controller: inert when disabled, service endpoints, convergence
 # --------------------------------------------------------------------------

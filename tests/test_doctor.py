@@ -54,10 +54,10 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
                       "--scheduler", "127.0.0.1:1"])
     out = capsys.readouterr().out
     assert rc == 1
-    # registry + fleetquery + scheduler + autopilot + serving + slo +
-    # invariants + gangs + ledger + preempt + prof + decisions + leases
-    # all refuse
-    assert out.count("fail") == 13
+    # registry + fleetquery + scheduler + autopilot + rightsize +
+    # serving + slo + invariants + gangs + ledger + preempt + prof +
+    # decisions + leases all refuse
+    assert out.count("fail") == 14
 
 
 def test_doctor_cli_subprocess():
@@ -123,10 +123,10 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
                       "--scheduler", f"127.0.0.1:{ports[1]}"])
     out = capsys.readouterr().out
     assert rc == 1, out
-    # registry + fleetquery + scheduler + autopilot + serving + slo +
-    # invariants + gangs + ledger + preempt + prof + decisions + leases
-    # all refuse
-    assert out.count("fail") == 13, out
+    # registry + fleetquery + scheduler + autopilot + rightsize +
+    # serving + slo + invariants + gangs + ledger + preempt + prof +
+    # decisions + leases all refuse
+    assert out.count("fail") == 14, out
 
 
 def test_doctor_serving_probe_skip_then_ok(capsys, monkeypatch):

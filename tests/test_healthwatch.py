@@ -425,3 +425,65 @@ def test_registry_partition_freezes_health(clock):
     cl.registry.leases = real_leases           # partition heals; beats
     cl.run(2.0)                                # resume before staleness
     assert all(st.state == UP for st in cl.hw.nodes.values())
+
+
+# -- cadence surface: seconds_until_due drives the wait loops ----------------
+
+
+def test_seconds_until_due_before_at_and_after_the_deadline(clock):
+    hw = HealthWatch(TelemetryRegistry(clock=clock), poll_period_s=10.0,
+                     clock=clock)
+    # never polled: due immediately
+    assert hw.seconds_until_due(clock.t) == 0.0
+    hw.poll(clock.t)
+    assert hw.seconds_until_due(clock.t) == pytest.approx(10.0)
+    assert hw.seconds_until_due(clock.t + 4.0) == pytest.approx(6.0)
+    assert hw.seconds_until_due(clock.t + 10.0) == 0.0
+    # past due clamps to zero, never goes negative
+    assert hw.seconds_until_due(clock.t + 25.0) == 0.0
+    # an early poll is a cadence no-op: it must not push the deadline
+    hw.poll(clock.t + 4.0)
+    assert hw.seconds_until_due(clock.t + 4.0) == pytest.approx(6.0)
+
+
+def test_dispatcher_next_delay_schedules_against_the_poll(clock):
+    """step() returns the seconds until the next timed event; with a
+    healthwatch attached that event is the poll deadline, not the 30 s
+    GC cadence — the run loop wakes exactly when a poll is due instead
+    of sleeping through half a detection window."""
+    from kubeshare_tpu.scheduler.dispatcher import GC_PERIOD_S
+
+    disp = Dispatcher(make_engine(hosts=1, clock=clock),
+                      TelemetryRegistry(clock=clock), clock=clock)
+    # no healthwatch: GC is the only timed event
+    assert disp.step() == pytest.approx(GC_PERIOD_S)
+    hw = HealthWatch(TelemetryRegistry(clock=clock), poll_period_s=10.0,
+                     clock=clock)
+    disp.attach_healthwatch(hw)
+    assert disp.step() == pytest.approx(10.0)      # polled now, due in 10
+    clock.t += 4.0
+    assert disp.step() == pytest.approx(6.0)       # mid-window remainder
+
+
+def test_sharded_pump_schedules_against_seconds_until_due(clock):
+    """The sharded plane's pump owns the healthwatch: its step() return
+    is bounded by seconds_until_due and the poll only laps the pump
+    profiler when actually due."""
+    from kubeshare_tpu.scheduler.shard import make_dispatcher
+
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=2, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    plane = make_dispatcher(by_host, shards=2, clock=clock)
+    hw = HealthWatch(TelemetryRegistry(clock=clock), poll_period_s=10.0,
+                     clock=clock)
+    plane.attach_healthwatch(hw)
+    assert plane.step() == pytest.approx(10.0)
+    assert plane.prof_pump.phase_counts.get("healthwatch", 0) == 1
+    clock.t += 4.0
+    assert plane.step() == pytest.approx(6.0)
+    # not due: consumed no poll, charged no pump lap
+    assert plane.prof_pump.phase_counts.get("healthwatch", 0) == 1
+    clock.t += 6.0
+    plane.step()
+    assert plane.prof_pump.phase_counts.get("healthwatch", 0) == 2
