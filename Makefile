@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-rightsize bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay bench-shard image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-rightsize bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay bench-shard bench-failover image clean obs-check
 
 all: native
 
@@ -185,6 +185,16 @@ bench-replay:
 bench-shard:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_shard.py --check \
 		--baseline bench_shard.json --write bench_shard.json
+
+# Control-plane HA bench (doc/ha.md): seeded scheduler kills and
+# registry-leader kills under virtual clocks; --check gates takeover
+# and registry-failover MTTR p99 under 3x the health plane's node-death
+# detection (bench_health.json), replication lag inside its advertised
+# bound, and the per-bind fence check at <=2% of one admission check,
+# then refreshes bench_failover.json.
+bench-failover:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_failover.py --check \
+		--baseline bench_failover.json --write bench_failover.json
 
 image:
 	docker build -f docker/Dockerfile -t kubeshare-tpu:latest .

@@ -394,6 +394,55 @@ def check_autopilot_journal_idempotent(path) -> list[dict]:
     return out
 
 
+# -- HA: single-writer across leadership transitions ---------------------
+
+
+def check_single_writer(registry, active_engine=None, deposed=(),
+                        final: bool = False) -> list[dict]:
+    """Epoch-fenced leadership holds (doc/ha.md): fenced writes the
+    registry ACCEPTED came from a non-decreasing epoch sequence — once
+    epoch N+1 writes, epoch N never writes again — and (``final``, at
+    convergence) every deposed dispatcher is frozen, every pod record
+    the registry holds is backed by a booking on the active engine, and
+    the nodes agree (no double-booking across the takeover).
+
+    The transient checks are samplable mid-window; the ``final`` checks
+    only hold once the partition healed and the deposed side observed
+    the new epoch, so the runner asserts them at convergence.
+    """
+    out: list[dict] = []
+    log = list(getattr(registry, "fence_log", ()))
+    for a, b in zip(log, log[1:]):
+        if b < a:
+            out.append(violation(
+                "single-writer",
+                f"accepted fenced write regressed epoch {a} -> {b}: "
+                f"two leaders wrote interleaved", epochs=[a, b]))
+    if not final:
+        return out
+    for disp in deposed:
+        if not getattr(disp, "frozen", True):
+            out.append(violation(
+                "deposed-frozen",
+                "deposed dispatcher still placing after the takeover"))
+    if active_engine is not None:
+        for key, rec in registry.pods().items():
+            pod = active_engine.pod_status.get(key)
+            if pod is None:
+                out.append(violation(
+                    "lost-bound-pod",
+                    f"registry holds {key} but the active engine does "
+                    f"not — the takeover dropped a bound pod", pod=key))
+            elif (pod.node_name and rec.get("node")
+                    and pod.node_name != rec["node"]):
+                out.append(violation(
+                    "double-booking",
+                    f"{key} booked on {pod.node_name} but the registry "
+                    f"says {rec['node']}: stale epoch write survived",
+                    pod=key))
+    return out
+
+
 # -- aggregate ----------------------------------------------------------
 
 

@@ -58,6 +58,108 @@ class _PartitionedRegistry:
         return call
 
 
+class _LiveRegistry:
+    """Registry proxy that always resolves the runner's CURRENT
+    registry object — after a ``registry_leader_kill`` promotes the
+    follower, every plane holding this proxy is already failed over
+    (the virtual-time collapse of ``RegistryClient``'s multi-endpoint
+    rotation)."""
+
+    def __init__(self, runner):
+        self._runner = runner
+
+    def __getattr__(self, name):
+        return getattr(self._runner.registry, name)
+
+
+class _HaPlane:
+    """In-process control-plane HA under the nemesis (doc/ha.md): a
+    follower registry tailing the primary's op-stream, a warm-standby
+    scheduler on the un-partitioned side, and epoch-fenced leadership
+    for both dispatchers.  The registry partition window applies to the
+    PRIMARY scheduler only — the standby and the replication stream
+    live on the healthy side, which is exactly the asymmetric partition
+    the fencing protocol exists for."""
+
+    LEASE_TTL_S = 1.5
+
+    def __init__(self, runner):
+        from ..ha import ReplicationFollower, WarmStandby
+        from ..scheduler import SchedulerEngine
+        from ..scheduler.dispatcher import Dispatcher
+        from ..telemetry.aggregator import sync_engine_from_registry
+        from ..telemetry.registry import TelemetryRegistry
+
+        self.runner = runner
+        # takeover reconstruction reads capacity -> bound pods from the
+        # registry, so the fleet must be on the bus first (in a real
+        # deployment the collectors already put it there)
+        eng = runner.disp.engine
+        for node, models in sorted(eng.chips_by_node.items()):
+            chips = sorted((c for chips_ in models.values()
+                            for c in chips_), key=lambda c: c.chip_id)
+            runner.registry.put_capacity(
+                node, [c.to_labels() for c in chips],
+                healthy=bool(eng.node_health.get(node, True)))
+        self.follower_journal = os.path.join(runner.workdir,
+                                             "follower.jsonl")
+        self.follower = TelemetryRegistry(journal=self.follower_journal,
+                                          clock=runner._clock)
+        self.repl = ReplicationFollower(
+            self.follower, _LiveRegistry(runner), leader_hint="primary",
+            poll_s=TICK_S, clock=runner._clock)
+        live = _LiveRegistry(runner)
+        self.standby_engine = SchedulerEngine(clock=runner._clock)
+        self.standby_disp = Dispatcher(
+            self.standby_engine, registry=live, clock=runner._clock,
+            sync=lambda: sync_engine_from_registry(self.standby_engine,
+                                                   live),
+            name="standby")
+        self.primary_ha = WarmStandby(
+            runner.disp, _PartitionedRegistry(runner), "primary",
+            ttl_s=self.LEASE_TTL_S, clock=runner._clock,
+            resync_period_s=0.5)
+        self.standby_ha = WarmStandby(
+            self.standby_disp, live, "standby",
+            ttl_s=self.LEASE_TTL_S, clock=runner._clock,
+            resync_period_s=0.5)
+        self.silenced_until = -1.0
+        self.promoted = False
+
+    def tick(self, now: float) -> None:
+        if now >= self.silenced_until:
+            self.runner.disp.step(now)
+            self.primary_ha.step(now)
+        if not self.promoted:
+            self.repl.step(now)
+        self.standby_ha.step(now)
+        self.standby_disp.step(now)
+        self._drain_failover()
+
+    def _drain_failover(self) -> None:
+        """The bridge model: pods queued on a frozen dispatcher are
+        resubmitted to the current leader — the informer replay a real
+        control plane gets for free from the API server (the pods still
+        exist there; only their scheduler died)."""
+        runner = self.runner
+        for src, dst, dst_ha in (
+                (runner.disp, self.standby_disp, self.standby_ha),
+                (self.standby_disp, runner.disp, self.primary_ha)):
+            if not getattr(src, "frozen", False) \
+                    or not dst_ha.lead.is_leader:
+                continue
+            with src.lock:
+                keys = [k for k in src._pending
+                        if k in runner._submitted]
+            for key in keys:
+                ns, name, labels = runner._submitted[key]
+                src.delete(key)
+                try:
+                    dst.submit(ns, name, dict(labels))
+                except Exception:
+                    pass    # duplicate/raced resubmit — the next drain
+
+
 class _CrashableServable:
     """LocalServable that hard-fails inside the crash window — the
     virtual-time stand-in for a proxy ``kill -9`` mid-batch.  Riders
@@ -152,6 +254,12 @@ class ChaosRunner:
         self.gangcoord.auto_drive = True
         self.disp.attach_gang_coordinator(self.gangcoord)
         self.parked: dict[str, dict] = {}        # tenant -> manifest
+        #: HA plane (ha_enable action): follower registry + standby
+        #: scheduler + leadership for both dispatchers (doc/ha.md)
+        self.ha: _HaPlane | None = None
+        #: every submitted pod's (ns, name, labels) — the failover
+        #: drain's stand-in for the API server's pod store
+        self._submitted: dict[str, tuple] = {}
         self._serve_results: list = []
         self._lease_epoch = 0
         self._next_lease = 0.0
@@ -167,6 +275,19 @@ class ChaosRunner:
 
     def partitioned(self) -> bool:
         return self.now < self._partition_until
+
+    @property
+    def active_disp(self):
+        """The dispatcher currently holding ``leader:scheduler`` —
+        submits route here and convergence/invariants are judged on it
+        (without HA it is always the primary)."""
+        if self.ha is not None and self.ha.standby_ha.lead.is_leader:
+            return self.ha.standby_disp
+        return self.disp
+
+    @property
+    def active_engine(self):
+        return self.active_disp.engine
 
     # -- action execution -----------------------------------------------
 
@@ -185,7 +306,7 @@ class ChaosRunner:
             labels = {C.POD_TPU_REQUEST: str(p.get("request", 0.5)),
                       C.POD_TPU_LIMIT: "1.0"}
             for i in range(int(p.get("count", 1))):
-                self.disp.submit(ns, f"{prefix}{i}", dict(labels))
+                self._submit(ns, f"{prefix}{i}", dict(labels))
         elif act.action == "submit_gang":
             labels = {C.POD_TPU_REQUEST: str(p.get("request", 0.5)),
                       C.POD_TPU_LIMIT: "1.0",
@@ -195,7 +316,7 @@ class ChaosRunner:
             if p.get("class"):
                 labels[C.POD_CLASS] = p["class"]
             for i in range(int(p["headcount"])):
-                self.disp.submit("chaos", f"{p['name']}-{i}", dict(labels))
+                self._submit("chaos", f"{p['name']}-{i}", dict(labels))
         elif act.action == "delete_prefix":
             with self.disp.lock:
                 keys = [k for k, pod in self.engine.pod_status.items()
@@ -229,6 +350,15 @@ class ChaosRunner:
                 self.disp.fail_commit_at = int(p.get("at", 1))
         elif act.action == "registry_restart":
             self._restart_registry()
+        elif act.action == "ha_enable":
+            self.ha = _HaPlane(self)
+        elif act.action == "leader_silence":
+            # the primary scheduler stops entirely (process freeze):
+            # no steps, no lease renewals — the standby's takeover clock
+            self.ha.silenced_until = self.now + float(
+                p.get("duration_s", 3.0))
+        elif act.action == "registry_leader_kill":
+            self._registry_leader_kill()
         elif act.action == "registry_partition":
             self._partition_until = self.now + float(
                 p.get("duration_s", 1.0))
@@ -271,6 +401,10 @@ class ChaosRunner:
         else:
             raise ValueError(f"unknown chaos action {act.action!r}")
 
+    def _submit(self, ns: str, name: str, labels: dict) -> None:
+        self._submitted[f"{ns}/{name}"] = (ns, name, dict(labels))
+        self.active_disp.submit(ns, name, labels)
+
     def _restart_registry(self) -> None:
         from ..telemetry.registry import TelemetryRegistry
 
@@ -282,6 +416,27 @@ class ChaosRunner:
                 self.registry_journal))
         self.registry = TelemetryRegistry(journal=self.registry_journal,
                                           clock=self._clock)
+
+    def _registry_leader_kill(self) -> None:
+        """Kill the primary registry abruptly and promote the follower:
+        the journal is closed (replay idempotency asserted on the
+        corpse), the follower stops tailing and flips writable, and
+        every plane holding a ``_LiveRegistry`` proxy has already
+        failed over — the ``RegistryClient`` multi-endpoint rotation,
+        collapsed to virtual time.  Ops past the follower's last pull
+        are lost: that is the documented bounded-lag trade, and the
+        single-writer invariant must still hold on the survivor."""
+        ha = self.ha
+        if self.registry._journal is not None:
+            self.registry._journal.close()
+        self.violations.extend(
+            dict(v, at_s=round(self.now, 3)) for v in
+            invariants.check_registry_replay_idempotent(
+                self.registry_journal))
+        ha.repl.promote()
+        ha.promoted = True
+        self.registry = ha.follower
+        self.registry_journal = ha.follower_journal
 
     def _autopilot_cycle(self) -> None:
         if self.autopilot is None:
@@ -366,9 +521,9 @@ class ChaosRunner:
         accounting code, not a re-derivation."""
         from ..isolation.tokensched import TokenScheduler
 
-        with self.disp.lock:
+        with self.active_disp.lock:
             want: dict[str, dict[str, float]] = {}
-            for pod in self.engine.pod_status.values():
+            for pod in self.active_engine.pod_status.values():
                 for chip_id, compute, _mem in getattr(pod, "bookings", ()):
                     want.setdefault(chip_id, {})[pod.key] = compute
         for chip_id, clients in want.items():
@@ -401,14 +556,21 @@ class ChaosRunner:
     def _sample(self, where: str, journals: bool = False) -> list[dict]:
         self.samples += 1
         self._sync_token_scheds()
-        with self.disp.lock:
-            in_flight = (set(self.disp._pending)
-                         | set(self.disp._parked))
-            if self.shards > 1:
+        active = self.active_disp
+        with active.lock:
+            in_flight = (set(active._pending)
+                         | set(active._parked))
+            if self.shards > 1 and active is self.disp:
                 found = invariants.check_cross_shard(
                     [sh.engine for sh in self.disp.shards], in_flight)
             else:
-                found = invariants.check_engine(self.engine, in_flight)
+                found = invariants.check_engine(active.engine, in_flight)
+        if self.ha is not None:
+            deposed = [d for d in (self.disp, self.ha.standby_disp)
+                       if d is not active]
+            found.extend(invariants.check_single_writer(
+                self.registry, active_engine=active.engine,
+                deposed=deposed, final=journals))
         found.extend(invariants.check_token_shares(self.token_scheds))
         found.extend(invariants.check_gang_grant_atomicity(
             self.gangcoord, now=self.now, slack_s=2 * TICK_S))
@@ -439,7 +601,10 @@ class ChaosRunner:
                     except OSError:
                         pass            # partitioned — the point
             self._next_lease = self.now + LEASE_EVERY_S
-        self.disp.step(self.now)
+        if self.ha is not None:
+            self.ha.tick(self.now)   # steps BOTH dispatchers + leases
+        else:
+            self.disp.step(self.now)
         if self.gangcoord.gangs():
             # keep the mirror fresh so gang grants see real schedulers,
             # then advance every gang's grant cycle one notch
@@ -450,9 +615,15 @@ class ChaosRunner:
     def _converged(self) -> bool:
         if self.partitioned() or self.now < self.servable.crashed_until:
             return False
-        with self.disp.lock:
-            if self.disp._pending or self.disp._parked:
-                return False
+        if self.ha is not None and self.now < self.ha.silenced_until:
+            return False
+        disps = [self.disp]
+        if self.ha is not None:
+            disps.append(self.ha.standby_disp)
+        for disp in disps:
+            with disp.lock:
+                if disp._pending or disp._parked:
+                    return False
         with self.fd.lock:
             if any(t.queue for t in self.fd._tenants.values()):
                 return False

@@ -43,6 +43,16 @@ Action vocabulary (executed by ``orchestrator.ChaosRunner``):
                       next cross-shard gang commit dies after ``at``
                       members, exercising trial-book rollback (no-op on
                       the single-lock dispatcher)
+``ha_enable``         stand up the HA plane (doc/ha.md): follower
+                      registry tailing the op-stream, warm-standby
+                      scheduler, epoch-fenced leadership on both
+                      dispatchers
+``leader_silence``    the primary scheduler stops entirely for the
+                      window (params: duration_s) — no steps, no lease
+                      renewals; the standby's takeover clock
+``registry_leader_kill`` kill the primary registry abruptly and promote
+                      the follower; clients fail over, bounded-lag ops
+                      are lost by design, single-writer must hold
 """
 
 from __future__ import annotations
@@ -329,6 +339,56 @@ def resize_mid_eviction(seed: int) -> Scenario:
         ])
 
 
+def registry_leader_kill_mid_bind_publish(seed: int) -> Scenario:
+    """The registry leader is killed abruptly while bindings are being
+    published — the follower promotes with whatever its cursor reached
+    (bounded-lag: trailing ops are lost by design), clients fail over,
+    and the scheduler keeps its leadership across the registry failover
+    (the ``leader:scheduler`` lease replicated with a restart-grace
+    TTL).  The single-writer invariant must hold on the survivor and
+    the late wave must bind through the promoted registry."""
+    r = _rng("registry-leader-kill-mid-bind-publish", seed)
+    kill_at = _j(r, 0.6, 0.4)
+    return Scenario(
+        "registry-leader-kill-mid-bind-publish",
+        "registry leader killed mid bind-publish; follower promotes",
+        [
+            ChaosAction(0.0, "ha_enable"),
+            ChaosAction(0.2, "submit", params={"count": 4,
+                                               "request": 0.5}),
+            ChaosAction(kill_at, "registry_leader_kill"),
+            ChaosAction(_j(r, kill_at + 0.1, 0.2), "submit",
+                        params={"count": 3, "request": 0.4,
+                                "prefix": "late"}),
+        ])
+
+
+def partition_with_standby_takeover(seed: int) -> Scenario:
+    """The primary scheduler is partitioned from the registry past the
+    leadership TTL: its publishes roll back, its lease expires, the
+    warm standby takes over at the next epoch and replays the bound
+    set.  When the partition heals, the deposed primary's first fenced
+    write (or refused renewal) must FREEZE it — writes from at most one
+    epoch ever land, no bound pod is lost, no chip double-booked."""
+    r = _rng("partition-with-standby-takeover", seed)
+    part_at = _j(r, 0.8, 0.3)
+    return Scenario(
+        "partition-with-standby-takeover",
+        "primary partitioned past the lease TTL; standby takes over, "
+        "deposed leader freezes",
+        [
+            ChaosAction(0.0, "ha_enable"),
+            ChaosAction(0.1, "submit", params={"count": 4,
+                                               "request": 0.5}),
+            ChaosAction(part_at, "registry_partition",
+                        params={"duration_s": round(
+                            2.5 + r.random() * 0.5, 3)}),
+            ChaosAction(part_at + 0.1, "submit",
+                        params={"count": 3, "request": 0.4,
+                                "prefix": "late"}),
+        ])
+
+
 BUILDERS = {
     "node-crash-flap": node_crash_flap,
     "registry-restart-mid-lease": registry_restart_mid_lease,
@@ -340,6 +400,9 @@ BUILDERS = {
     "preemption-vs-migration": preemption_vs_migration,
     "cross-shard-gang-commit-fail": cross_shard_gang_commit_fail,
     "resize-mid-eviction": resize_mid_eviction,
+    "registry-leader-kill-mid-bind-publish":
+        registry_leader_kill_mid_bind_publish,
+    "partition-with-standby-takeover": partition_with_standby_takeover,
 }
 
 

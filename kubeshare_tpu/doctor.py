@@ -502,6 +502,53 @@ def check_decisions(addr: str, timeout_s: float,
         f"{len(kinds)} kind(s))")
 
 
+def check_ha(addr: str, timeout_s: float,
+             defaulted: bool = False) -> bool:
+    """Control-plane HA probe (doc/ha.md): ``/ha`` must answer; a
+    scheduler outside any election is a skip (HA is opt-in via
+    ``--ha-holder``). A participating scheduler fails when it claims
+    the lease yet its dispatcher is frozen (a leader that cannot
+    place), or when its registry's replication follower is out of sync
+    beyond the advertised lag bound."""
+    if not addr or addr == "none":
+        return _result("ha", "skip", "--scheduler none")
+    try:
+        state = json.loads(_get(f"http://{addr}/ha", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("ha", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("ha", "skip", "scheduler predates /ha")
+        return _result("ha", "fail", f"{addr}: {exc}")
+    if not state.get("attached"):
+        return _result("ha", "skip",
+                       "not in an election (start the scheduler with "
+                       "--ha-holder to enable)")
+    role = state.get("role", "?")
+    epoch = state.get("epoch", 0)
+    if role == "leader" and state.get("frozen"):
+        return _result("ha", "fail",
+                       f"{addr}: holds leader:scheduler at epoch "
+                       f"{epoch} but the dispatcher is FROZEN "
+                       f"({state.get('last_error') or 'fenced?'}) — a "
+                       "leader that cannot place pods")
+    repl = state.get("replication") or {}
+    lag, bound = repl.get("lag_s"), repl.get("lag_bound_s")
+    if (lag is not None and bound is not None
+            and not repl.get("in_sync") and float(lag) > float(bound)):
+        return _result("ha", "fail",
+                       f"{addr}: replication {float(lag):.1f}s behind "
+                       f"(bound {float(bound):.1f}s) — a takeover now "
+                       "would lose that window")
+    detail = (f"{addr}: {role} at epoch {epoch}, "
+              f"{state.get('takeovers', 0)} takeover(s)")
+    if lag is not None:
+        detail += f", replication lag {float(lag):.1f}s"
+    return _result("ha", "ok", detail)
+
+
 def check_slo(addr: str, timeout_s: float,
               defaulted: bool = False) -> bool:
     """SLO-plane probe (doc/observability.md): ``/slo`` must answer and
@@ -752,6 +799,7 @@ def main(argv=None) -> int:
     ok &= check_preempt(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_prof(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_decisions(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_ha(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_node_files(args.base_dir)
     from .utils import default_node_name
     ok &= check_leases(registry, 5.0, default_node_name(),

@@ -178,30 +178,65 @@ class ServiceClient:
     mid-chaos does not fail watchers that could simply redial.  HTTP
     error *responses* are never retried: the service answered, and the
     schedule/resync bodies are idempotent only on the service side.
+
+    **HA (doc/ha.md):** ``base_url`` may be a list (or comma-separated
+    string) of scheduler endpoints — a primary/standby pair. Each
+    transport failure rotates to the next endpoint before the backoff,
+    so the bridge follows a takeover without reconfiguration (the
+    deposed scheduler's frozen dispatcher still *answers*, it just
+    parks pods — the 202 poll loop rides out the transition).
+    ``schedule`` is the one non-idempotent op: it is only re-sent when
+    the failure proves the request never reached a server (connection
+    refused), never after an ambiguous timeout.
     """
 
     RETRY_ATTEMPTS = 3
     RETRY_BACKOFF_S = 0.05
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
-        self.base_url = base_url.rstrip("/")
+    def __init__(self, base_url: str | list[str], timeout: float = 30.0,
+                 seed: int | None = None):
+        if isinstance(base_url, str):
+            endpoints = base_url.split(",")
+        else:
+            endpoints = list(base_url)
+        self._bases = [u.strip().rstrip("/") for u in endpoints
+                       if u.strip()]
+        if not self._bases:
+            raise ValueError("ServiceClient needs at least one endpoint")
+        self._idx = 0
         self.timeout = timeout
+        self._rng = random.Random(seed)
         self._open = urllib.request.urlopen   # injectable for tests
 
-    def _call(self, method: str, path: str,
-              body: dict | None = None) -> tuple[int, dict]:
-        req = urllib.request.Request(self.base_url + path, method=method)
+    @property
+    def base_url(self) -> str:
+        """The currently preferred endpoint (back-compat accessor)."""
+        return self._bases[self._idx]
+
+    @staticmethod
+    def _unambiguous(exc: Exception) -> bool:
+        """True when the request provably never reached a server
+        (connection refused) — the only transport failure a
+        non-idempotent op may be resent after."""
+        reason = getattr(exc, "reason", exc)
+        return isinstance(reason, ConnectionRefusedError)
+
+    def _call(self, method: str, path: str, body: dict | None = None,
+              idempotent: bool = True) -> tuple[int, dict]:
         data = None
         if body is not None:
             data = json.dumps(body).encode()
-            req.add_header("Content-Type", "application/json")
         op = f"{method} /{path.strip('/').split('/')[0].split('?')[0]}"
         last_exc: Exception = OSError("unreachable")
         for attempt in range(self.RETRY_ATTEMPTS):
             if attempt:
                 _SVC_RETRIES.inc(op)
                 time.sleep(self.RETRY_BACKOFF_S * (2 ** (attempt - 1))
-                           * (0.5 + random.random()))
+                           * (0.5 + self._rng.random()))
+            req = urllib.request.Request(self.base_url + path,
+                                         method=method)
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
             try:
                 # chaos drill: a partitioned/bounced service looks like
                 # a transport failure (resilience/faults.py)
@@ -222,13 +257,20 @@ class ServiceClient:
                 log.warning("service %s %s attempt %d/%d failed: %s",
                             method, path, attempt + 1,
                             self.RETRY_ATTEMPTS, exc)
+                if not idempotent and not self._unambiguous(exc):
+                    raise   # may have been received: never double-send
+                if len(self._bases) > 1:
+                    # rotate before the backoff: after a takeover the
+                    # next endpoint is simply the live one (doc/ha.md)
+                    self._idx = (self._idx + 1) % len(self._bases)
         raise last_exc
 
     def schedule(self, namespace: str, name: str, labels: dict,
                  uid: str = "") -> tuple[int, dict]:
         return self._call("POST", "/schedule",
                           {"namespace": namespace, "name": name,
-                           "labels": labels, "uid": uid})
+                           "labels": labels, "uid": uid},
+                          idempotent=False)
 
     def resync(self, namespace: str, name: str, labels: dict,
                annotations: dict, node: str, uid: str = "") -> tuple[int, dict]:
@@ -341,6 +383,16 @@ class ServiceClient:
         code, body = self._call("GET", "/prof")
         if code != 200:
             raise RuntimeError(f"/prof returned {code}")
+        return body
+
+    def ha(self) -> dict:
+        """Control-plane HA snapshot (``GET /ha``, doc/ha.md):
+        leadership role, lease epoch, takeover history, replication
+        lag; ``{"attached": false}`` when the scheduler is not in an
+        election, RuntimeError when it predates the HA plane."""
+        code, body = self._call("GET", "/ha")
+        if code != 200:
+            raise RuntimeError(f"/ha returned {code}")
         return body
 
     def delete(self, namespace: str, name: str) -> tuple[int, dict]:
@@ -635,7 +687,9 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.scheduler.bridge")
     parser.add_argument("--service", required=True,
                         help="scheduler service base URL, e.g. "
-                             "http://kubeshare-tpu-scheduler:9007")
+                             "http://kubeshare-tpu-scheduler:9007; a "
+                             "comma-separated list enables failover "
+                             "across a primary/standby pair (doc/ha.md)")
     parser.add_argument("--kube-api", default="",
                         help="API server base URL (default: in-cluster env)")
     parser.add_argument("--scheduler-name", default=SCHEDULER_NAME)

@@ -210,6 +210,17 @@ class Dispatcher:
         #: encoding assumes full-fleet views, so the sharded plane
         #: records ONE merged view itself (scheduler.shard)
         self.record_views = True
+        #: leadership fence (attach_fencing): zero-arg callable giving
+        #: the epoch stamped onto every registry write; the registry
+        #: refuses a stale epoch 409 and the refusal freezes this
+        #: dispatcher — split-brain never reaches the record set
+        #: (doc/ha.md). None = unfenced, the exact pre-HA wire.
+        self._fence_epoch = None
+        #: a frozen dispatcher holds its queue instead of placing: the
+        #: standby discipline before takeover, and the deposed leader's
+        #: terminal state after a fenced 409 (freeze()/unfreeze())
+        self.frozen = False
+        self.frozen_reason = ""
         self._stop = False
         self._thread: threading.Thread | None = None
 
@@ -271,6 +282,51 @@ class Dispatcher:
                 nodes[node] = [c.to_labels() for c in chips]
             rec.record("fleet", self._clock(), nodes=nodes)
         return self
+
+    def attach_fencing(self, epoch_fn) -> "Dispatcher":
+        """Wire a leadership epoch source (:class:`~..ha.WarmStandby`):
+        every registry write — publish, rebind, withdraw — carries
+        ``epoch_fn()`` as a fence, and a 409 refusal freezes this
+        dispatcher instead of letting a deposed leader double-book the
+        fleet (doc/ha.md)."""
+        self._fence_epoch = epoch_fn
+        return self
+
+    def _fence(self) -> int | None:
+        return (None if self._fence_epoch is None
+                else int(self._fence_epoch()))
+
+    def freeze(self, reason: str = "") -> None:
+        """Stop placing pods. Submits still land, reads still serve,
+        the queue holds its state — only the placement pass stops, so
+        an unfreeze resumes exactly where the freeze caught the queue.
+        Idempotent; the later reason wins."""
+        with self._cond:
+            first = not self.frozen
+            self.frozen = True
+            if reason or first:
+                self.frozen_reason = reason
+            if first:
+                log.warning("dispatcher frozen: %s", reason)
+                default_recorder().note("dispatcher", "frozen",
+                                        reason=reason)
+
+    def unfreeze(self) -> None:
+        """Resume placement (takeover / re-election thaw)."""
+        with self._cond:
+            if not self.frozen:
+                return
+            self.frozen = False
+            self.frozen_reason = ""
+            log.warning("dispatcher thawed: placement resumes")
+            default_recorder().note("dispatcher", "thawed")
+            self._cond.notify_all()
+
+    def _freeze_fenced(self, exc) -> None:
+        """A fenced 409 is the registry telling us a newer epoch leads:
+        freeze in place (caller holds the lock)."""
+        self.freeze(f"fenced at epoch {exc.fence}: "
+                    f"epoch {exc.current} leads")
 
     def _decision_view(self) -> dict:
         """Compact capacity/health view ``{node: "free|health"}`` for
@@ -537,7 +593,11 @@ class Dispatcher:
             self._next_gc = now + self.gc_period_s
         span.lap("queue-poll")
 
-        if self.healthwatch is not None and self.healthwatch.due(now):
+        if (self.healthwatch is not None and not self.frozen
+                and self.healthwatch.due(now)):
+            # a frozen dispatcher must not run detection either: the
+            # leader owns the fleet; a standby evicting nodes off its
+            # warm copy would fight the leader's bookings (doc/ha.md)
             # the due-gate keeps the phase bracket honest: a poll that
             # would no-op on its cadence must not lap time into the
             # "healthwatch" phase (phantom coverage — doc/sharding.md,
@@ -610,6 +670,10 @@ class Dispatcher:
     def _drain_ready(self, now: float, span) -> None:
         """Schedule every ready pod, highest queue_less first (caller
         holds the lock)."""
+        if self.frozen:
+            # the queue holds: pending pods keep their timestamps and
+            # backoffs for the thaw (or the new leader's replay)
+            return
         synced = False
         progressed = True
         while progressed:
@@ -729,9 +793,22 @@ class Dispatcher:
         bind_ts0 = tracer.now_ms()
         if self.registry is not None and pod.needs_tpu:
             from ..telemetry.aggregator import publish_binding
+            from ..telemetry.registry import FencedWriteError
 
             try:
-                publish_binding(self.registry, pod, binding)
+                publish_binding(self.registry, pod, binding,
+                                fence=self._fence())
+            except FencedWriteError as e:
+                # a newer epoch leads — we are deposed. Roll back and
+                # freeze; the pod stays queued for the real leader (or
+                # our own thaw after re-election). Distinct from the
+                # transient branch below: retrying a fenced write can
+                # never succeed at this epoch.
+                self.engine.unreserve(pod)
+                self._requeue(pod, now, f"publish fenced: {e}")
+                self._freeze_fenced(e)
+                span.lap("publish")
+                return
             except Exception as e:
                 # transient registry failure must not kill the loop thread
                 # nor leak the fresh reservation — roll back and retry
@@ -1047,9 +1124,15 @@ class Dispatcher:
         binding = self.engine.reserve(pod, node)
         if self.registry is not None and pod.needs_tpu:
             from ..telemetry.aggregator import publish_binding
+            from ..telemetry.registry import FencedWriteError
 
             try:
-                publish_binding(self.registry, pod, binding)
+                publish_binding(self.registry, pod, binding,
+                                fence=self._fence())
+            except FencedWriteError as e:
+                self.engine.unreserve(pod)
+                self._freeze_fenced(e)
+                raise Unschedulable(f"binding publish fenced: {e}")
             except Exception as e:
                 self.engine.unreserve(pod)
                 raise Unschedulable(f"binding publish failed: {e}")
@@ -1189,10 +1272,13 @@ class Dispatcher:
     def _withdraw(self, key: str) -> None:
         if self.registry is None:
             return
+        from ..telemetry.aggregator import withdraw
+        from ..telemetry.registry import FencedWriteError
         try:
-            from ..telemetry.aggregator import withdraw
-
-            withdraw(self.registry, key)
+            withdraw(self.registry, key, fence=self._fence())
+        except FencedWriteError as e:
+            self._freeze_fenced(e)
+            log.warning("withdraw %s fenced: %s", key, e)
         except Exception as e:
             log.warning("withdraw %s failed: %s", key, e)
 

@@ -154,6 +154,11 @@ class HealthWatch:
             return []
         changed: list[str] = []
         for node, lease in leases.items():
+            if node.startswith("leader:"):
+                # leadership leases (doc/ha.md) live in the same table
+                # but are not nodes — expiry there is the standby's
+                # takeover signal, not a death to evict over
+                continue
             ttl = float(lease.get("ttl_s", self.ttl_s)) or self.ttl_s
             age = float(lease.get("age_s", 0.0))
             epoch = int(lease.get("epoch", 0))

@@ -45,6 +45,9 @@ API (JSON):
 - ``GET  /preempt``   preemption plane: policy config + enforcement stats
   (preemptions fired, quantum reclaimed, gang preemptions; ``attached:
   false`` until a policy is wired — doc/isolation-wire.md)
+- ``GET  /ha``        control-plane HA: leadership role, lease epoch,
+  takeover history, frozen state, replication lag (doc/ha.md;
+  ``attached: false`` when this service is not in an election)
 - ``GET  /healthz``
 
 Overload shedding: with ``max_pending`` set, ``POST /schedule`` answers
@@ -157,6 +160,11 @@ class SchedulerService:
         self.rightsizer = None
         self.serving = None
         self.remote_write = None
+        # control-plane HA (doc/ha.md): None until attach_standby —
+        # GET /ha reports detached and no fencing is applied
+        self.standby = None
+        self._ha_thread: threading.Thread | None = None
+        self._ha_stop = threading.Event()
 
     def start_remote_write(self, instance: str | None = None,
                            job: str = "scheduler",
@@ -204,6 +212,23 @@ class SchedulerService:
         self.preempt = policy
         self.gangcoord.preempt = policy
         policy.decisions = self.decisions
+        return self
+
+    def attach_standby(self, holder: str, ttl_s: float = 5.0,
+                       resync_period_s: float | None = None,
+                       resync_source=None) -> "SchedulerService":
+        """Join the ``leader:scheduler`` election (doc/ha.md). The
+        dispatcher freezes until this service holds the lease: a primary
+        simply acquires first and renews; a warm standby re-syncs its
+        engine on a cadence and takes over at the next epoch when the
+        lease expires. ``serve()`` starts the election thread; under a
+        virtual clock drive ``self.standby.step(now)`` directly."""
+        from ..ha import WarmStandby
+
+        self.standby = WarmStandby(
+            self.dispatcher, self.registry, holder, ttl_s=ttl_s,
+            resync_period_s=resync_period_s, resync_source=resync_source,
+            decisions=self.decisions)
         return self
 
     # -- operations --------------------------------------------------------
@@ -344,6 +369,25 @@ class SchedulerService:
         fill, per-kind counts, recent tail (doc/replay.md)."""
         return self.decisions.state()
 
+    def ha_state(self) -> dict:
+        """``GET /ha`` body: leadership role, lease epoch, takeover
+        history, frozen state (doc/ha.md); ``attached: false`` when this
+        service is not in an election. Includes the registry's
+        replication status when it exposes one."""
+        if self.standby is None:
+            return {"attached": False,
+                    "frozen": bool(getattr(self.dispatcher, "frozen",
+                                           False))}
+        st = self.standby.state()
+        repl = (getattr(self.registry, "replication_status", None)
+                or getattr(self.registry, "replication", None))
+        if repl is not None:
+            try:
+                st["replication"] = repl()
+            except Exception as e:
+                st["replication"] = {"error": str(e)}
+        return st
+
     def render_metrics(self) -> str:
         """Scheduler-side Prometheus exposition (the reference's only
         scheduler observability is log lines; SURVEY §5). Complements the
@@ -375,6 +419,30 @@ class SchedulerService:
                 "kubeshare_scheduler_topology_rebuilds_total "
                 f"{self.engine.rebuild_count}",
             ]
+        if self.standby is not None:
+            # HA gauges only exist once an election is joined — the
+            # exposition stays byte-identical with HA off (doc/ha.md)
+            lead = self.standby.lead
+            lines += [
+                *render_help_type("kubeshare_ha_leader", "gauge",
+                                  "1 when this scheduler holds the "
+                                  "leader:scheduler lease, else 0."),
+                f"kubeshare_ha_leader {1 if lead.is_leader else 0}",
+                *render_help_type("kubeshare_ha_epoch", "gauge",
+                                  "Leadership epoch fencing this "
+                                  "scheduler's registry writes."),
+                f"kubeshare_ha_epoch {lead.epoch}",
+                # takeovers are already counted by the obs registry
+                # (kubeshare_ha_takeovers_total{domain=...}) — only the
+                # gauges that need live standby state are hand-rendered
+                *render_help_type(
+                    "kubeshare_ha_last_takeover_timestamp_seconds",
+                    "gauge",
+                    "Scheduler-clock time of the last takeover "
+                    "(0 = never)."),
+                "kubeshare_ha_last_takeover_timestamp_seconds "
+                f"{self.standby.last_takeover_ts}",
+            ]
         return "\n".join(lines) + "\n" + render_default()
 
     @staticmethod
@@ -404,6 +472,21 @@ class SchedulerService:
             except Exception as e:
                 log.warning("startup replay skipped: %s", e)
         self.dispatcher.start()
+        if self.standby is not None and self._ha_thread is None:
+            # election cadence well inside the lease TTL (the ttl/3
+            # heartbeater rule) so a healthy leader never lapses
+            period = max(0.2, self.standby.lead.ttl_s / 3.0)
+
+            def _ha_loop():
+                while not self._ha_stop.wait(period):
+                    try:
+                        self.standby.step()
+                    except Exception:
+                        log.exception("ha election step failed")
+
+            self._ha_thread = threading.Thread(
+                target=_ha_loop, daemon=True, name="ha-election")
+            self._ha_thread.start()
         svc = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -460,6 +543,8 @@ class SchedulerService:
                     return self._reply(200, svc.prof_state())
                 if self.path == "/decisions":
                     return self._reply(200, svc.decisions_state())
+                if self.path == "/ha":
+                    return self._reply(200, svc.ha_state())
                 if self.path == "/evictions":
                     return self._reply(
                         200, {"evictions": svc.dispatcher.evictions()})
@@ -534,6 +619,17 @@ class SchedulerService:
         return self._server.server_address[1]
 
     def close(self) -> None:
+        if self._ha_thread is not None:
+            self._ha_stop.set()
+            self._ha_thread.join(timeout=5.0)
+            self._ha_thread = None
+        if self.standby is not None and self.standby.lead.is_leader:
+            # graceful handoff: drop the lease now so a standby takes
+            # over at the next tick instead of waiting out the TTL
+            try:
+                self.standby.lead.resign()
+            except Exception:
+                log.exception("leadership resign on close failed")
         if self.remote_write is not None:
             self.remote_write.stop()
             self.remote_write = None
@@ -561,7 +657,10 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="kubeshare_tpu.scheduler.service")
     from .. import constants as C
 
-    parser.add_argument("--registry-host", default="127.0.0.1")
+    parser.add_argument("--registry-host", default="127.0.0.1",
+                        help="registry endpoint; a comma-separated "
+                             "host[:port] list enables client failover "
+                             "across replicas (doc/ha.md)")
     parser.add_argument("--registry-port", type=int,
                         default=C.REGISTRY_PORT)
     parser.add_argument("--port", type=int, default=C.SCHEDULER_PORT)
@@ -639,6 +738,19 @@ def main(argv=None) -> None:
                         help="how long a latency-class request waits "
                              "behind a lower-class holder before it is "
                              "preempted (default: policy default)")
+    parser.add_argument("--ha-holder", default="",
+                        help="join the leader:scheduler election under "
+                             "this holder name (doc/ha.md): the "
+                             "dispatcher freezes until this process "
+                             "holds the lease and takes over with "
+                             "epoch-fenced binds when it expires "
+                             "(empty = HA off, pre-HA behavior)")
+    parser.add_argument("--ha-ttl", type=float, default=5.0,
+                        help="leadership lease TTL in seconds; the "
+                             "election is stepped at ttl/3")
+    parser.add_argument("--ha-resync-period", type=float, default=None,
+                        help="standby warm-resync period in seconds "
+                             "(default: the lease TTL)")
     args = parser.parse_args(argv)
 
     if args.flight_dump_dir:
@@ -650,7 +762,11 @@ def main(argv=None) -> None:
 
     config = load_config(args.config) if args.config else None
     engine = SchedulerEngine(config=config)
-    registry = RegistryClient(args.registry_host, args.registry_port)
+    endpoints = [h.strip() for h in args.registry_host.split(",")
+                 if h.strip()]
+    registry = RegistryClient(
+        endpoints if len(endpoints) > 1 else endpoints[0],
+        args.registry_port)
     svc = SchedulerService(
         engine, registry,
         healthwatch=(HealthWatch(registry, ttl_s=args.lease_ttl)
@@ -688,6 +804,9 @@ def main(argv=None) -> None:
         kwargs = ({} if args.preempt_grace_ms is None
                   else {"grace_ms": args.preempt_grace_ms})
         svc.attach_preempt(PreemptionPolicy(**kwargs))
+    if args.ha_holder:
+        svc.attach_standby(args.ha_holder, ttl_s=args.ha_ttl,
+                           resync_period_s=args.ha_resync_period)
     svc.serve(args.host, args.port)
     if not args.no_remote_write:
         svc.start_remote_write(period_s=args.push_period)

@@ -374,6 +374,27 @@ class ShardedDispatcher:
             sh.attach_decisions(rec, record_fleet=False)
         return self
 
+    def attach_fencing(self, epoch_fn) -> "ShardedDispatcher":
+        """Every shard's registry writes carry the same leadership
+        epoch (doc/ha.md): there is ONE ``leader:scheduler`` lease for
+        the whole plane, not one per shard."""
+        for sh in self.shards:
+            sh.attach_fencing(epoch_fn)
+        return self
+
+    def freeze(self, reason: str = "") -> None:
+        """Freeze every shard (standby discipline / deposed fence)."""
+        for sh in self.shards:
+            sh.freeze(reason)
+
+    def unfreeze(self) -> None:
+        for sh in self.shards:
+            sh.unfreeze()
+
+    @property
+    def frozen(self) -> bool:
+        return all(sh.frozen for sh in self.shards)
+
     # -- routing -------------------------------------------------------
 
     def home_shard(self, namespace: str, name: str,
@@ -646,6 +667,10 @@ class ShardedDispatcher:
                 progressed = False
                 best = None      # (shard, key, pod)
                 for sh in self.shards:
+                    if sh.frozen:
+                        # the global drain bypasses _drain_ready, so the
+                        # freeze gate (doc/ha.md) must repeat here
+                        continue
                     key = sh._pick(now)
                     if key is None:
                         continue

@@ -10,11 +10,13 @@ from .aggregator import (publish_binding, requirement_record,
                          sync_engine_from_registry, withdraw)
 from .collector import CapacityCollector
 from .heartbeat import Heartbeater
-from .registry import RegistryClient, TelemetryRegistry
+from .registry import (LEADER_PREFIX, FencedWriteError, NotLeaderError,
+                       RegistryClient, TelemetryRegistry)
 from .remote_write import RemoteWriter, default_instance
 
 __all__ = [
-    "CapacityCollector", "Heartbeater", "RegistryClient",
+    "CapacityCollector", "FencedWriteError", "Heartbeater",
+    "LEADER_PREFIX", "NotLeaderError", "RegistryClient",
     "RemoteWriter", "TelemetryRegistry", "default_instance",
     "publish_binding", "requirement_record",
     "sync_engine_from_registry", "withdraw",

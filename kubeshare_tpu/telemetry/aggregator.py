@@ -41,13 +41,25 @@ def requirement_record(pod: PodRequest, binding: Binding) -> dict:
 
 
 def publish_binding(registry: RegistryClient | TelemetryRegistry,
-                    pod: PodRequest, binding: Binding) -> None:
-    registry.put_pod(pod.key, requirement_record(pod, binding))
+                    pod: PodRequest, binding: Binding,
+                    fence: int | None = None) -> None:
+    """Publish one requirement record; with ``fence`` set the write
+    carries the scheduler's leadership epoch and a deposed leader is
+    refused 409 (doc/ha.md). No fence = the exact pre-HA call, so the
+    wire stays byte-identical for non-HA deployments."""
+    if fence is None:
+        registry.put_pod(pod.key, requirement_record(pod, binding))
+    else:
+        registry.put_pod(pod.key, requirement_record(pod, binding),
+                         fence=fence)
 
 
 def withdraw(registry: RegistryClient | TelemetryRegistry,
-             pod_key: str) -> None:
-    registry.drop_pod(pod_key)
+             pod_key: str, fence: int | None = None) -> None:
+    if fence is None:
+        registry.drop_pod(pod_key)
+    else:
+        registry.drop_pod(pod_key, fence=fence)
 
 
 def sync_engine_from_registry(engine,

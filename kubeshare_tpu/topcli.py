@@ -521,6 +521,20 @@ FLEET_LOCK_PANELS = (
 FLEET_SPARKS = (
     ("rpc rate", "kubeshare_proxy_rpc_latency_seconds_count", "rate"),
     ("pending pods", "kubeshare_scheduler_pending_pods", "sum"),
+    # replication staleness across takeovers (doc/ha.md); renders '·'
+    # until an HA follower pushes the family
+    ("repl lag p99", "kubeshare_ha_replication_lag_seconds", "quantile"),
+)
+
+#: (label, family, agg, group_label) — the --fleet HA panel
+#: (doc/ha.md): who holds leader:scheduler, at what epoch, takeovers
+#: in the window, and when leadership last moved — per instance
+FLEET_HA_PANELS = (
+    ("leader", "kubeshare_ha_leader", "latest", "instance"),
+    ("epoch", "kubeshare_ha_epoch", "latest", "instance"),
+    ("takeovers", "kubeshare_ha_takeovers_total", "increase", "instance"),
+    ("last takeover", "kubeshare_ha_last_takeover_timestamp_seconds",
+     "latest", "instance"),
 )
 
 _SPARK_BARS = "▁▂▃▄▅▆▇█"
@@ -910,6 +924,18 @@ def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
         for g in res.get("groups", []):
             gid = g["labels"].get(group, "")
             locks.setdefault(gid, {})[label] = g["value"]
+    # HA panel (doc/ha.md): leadership + takeover state per scheduler
+    # instance — same one-query-per-column shape as GANGS
+    ha: dict[str, dict] = {}
+    for label, family, agg, group in FLEET_HA_PANELS:
+        try:
+            res = client.query(family, agg=agg, window_s=window_s,
+                               by=(group,))
+        except Exception:
+            continue          # no HA deployment pushing; the table stands
+        for g in res.get("groups", []):
+            gid = g["labels"].get(group, "")
+            ha.setdefault(gid, {})[label] = g["value"]
     # CONTENTION panel (doc/observability.md): blame wait-seconds per
     # second, grouped by blamed tenant — who is costing the fleet time
     contention = []
@@ -928,7 +954,7 @@ def fleet_snapshot(client: RegistryClient, window_s: float = 60.0) -> dict:
             "window_s": float(window_s),
             "instances": instances, "panels": panels,
             "gangs": gangs, "preempt": preempt, "locks": locks,
-            "rightsize": rightsize, "contention": contention}
+            "rightsize": rightsize, "contention": contention, "ha": ha}
 
 
 def fleet_history(client: RegistryClient, watch_s: float,
@@ -1042,6 +1068,31 @@ def render_fleet(snap: dict) -> str:
                 f"{f'{wait:.3f}' if wait is not None else '-':>9} "
                 f"{_fmt_seconds(hold) if hold is not None else '-':>9} "
                 f"{row.get('contended') if row.get('contended') is not None else '-':>10}")
+    ha = snap.get("ha") or {}
+    if ha:
+        lines.append("HA (epoch-fenced leadership, doc/ha.md — "
+                     "GET /ha on each scheduler drills in)")
+        lines.append(f"  {'instance':<24} {'role':<8} {'epoch':>6} "
+                     f"{'takeovers':>10}  last takeover")
+        now = snap.get("now")
+        for gid in sorted(ha):
+            row = ha[gid]
+            role = ("leader" if row.get("leader") else
+                    "-" if row.get("leader") is None else "standby")
+            epoch = row.get("epoch")
+            last = row.get("last takeover")
+            if not last:
+                ago = "never"
+            elif now:
+                ago = _fmt_seconds(max(0.0, float(now) - float(last))) \
+                    + " ago"
+            else:
+                ago = f"@{last:.0f}"
+            lines.append(
+                f"  {gid:<24} {role:<8} "
+                f"{f'{epoch:g}' if epoch is not None else '-':>6} "
+                f"{row.get('takeovers') if row.get('takeovers') is not None else '-':>10}  "
+                f"{ago}")
     contention = snap.get("contention") or []
     if contention:
         lines.append("CONTENTION (blame wait-seconds per second, by "

@@ -1,0 +1,306 @@
+"""Control-plane HA benchmark: takeover MTTR, replication lag, fence
+cost (doc/ha.md).
+
+Three legs, the first two in *virtual* seconds (properties of the
+election TTLs and polling cadences, not of the machine running the
+bench), the third in wall time:
+
+- **Scheduler takeover**: kill the leading dispatcher at a seeded
+  phase and measure from the kill to the standby unfrozen and placing
+  pods — ``takeover_mttr_s_p50`` / ``_p99``. Gate: p99 under
+  ``3 x`` the health plane's ``detection_latency_s_p99``
+  (bench_health.json) — losing the whole scheduler must not cost more
+  than three node-death detections.
+- **Registry failover**: kill the leader registry mid-stream and
+  measure write unavailability — from the kill to the first write
+  accepted by the promoted follower (supervisor detects by missed
+  probes, then promotes) — ``registry_failover_s_p50`` / ``_p99``;
+  plus steady-state replication lag under a seeded write workload —
+  ``replication_lag_s_p50`` / ``_p99`` (gate: p99 under the advertised
+  ``lag_bound_s``).
+- **Fence cost**: wall-clock overhead of the epoch fence check on
+  ``put_pod`` — ``fence_overhead_us`` per op. Gate: no more than 2%
+  of one admission check (derived from bench_health.json's
+  ``admission_checks_per_sec``) — fencing must be invisible on the
+  bind hot path.
+
+Run: ``python scripts/bench_failover.py`` → one JSON object (committed
+as ``bench_failover.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers (``make bench-failover`` does
+both). ``--check`` exits non-zero unless the MTTR / lag / overhead
+bars hold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: seeded phases per leg; >= 3 per the acceptance criteria
+SEEDS = (3, 11, 23)
+#: kills per seed (each at a seeded phase within the lease period)
+RUNS_PER_SEED = 8
+
+#: election/lease parameters under test — the deployed defaults
+TTL_S = 5.0
+ELECTION_POLL_S = TTL_S / 3.0
+REPL_POLL_S = 0.5
+LAG_BOUND_S = 5.0
+#: registry supervisor: probe cadence and misses before promoting
+PROBE_S = 1.0
+PROBE_MISSES = 3
+
+_HIGHER_IS_BETTER = ()
+
+
+class _TickClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _health_baseline() -> dict:
+    path = Path(__file__).resolve().parent.parent / "bench_health.json"
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def bench_takeover() -> dict:
+    """Seeded scheduler kills: the standby's election poll discovers
+    the expired lease and takes over; MTTR is kill -> standby placing
+    (unfrozen, with a reconstructed engine)."""
+    from kubeshare_tpu.ha import WarmStandby
+    from kubeshare_tpu.scheduler import SchedulerEngine
+    from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+    from kubeshare_tpu.telemetry import (TelemetryRegistry,
+                                         sync_engine_from_registry)
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    mttrs = []
+    for seed in SEEDS:
+        rng = random.Random(seed)
+        for _ in range(RUNS_PER_SEED):
+            clock = _TickClock()
+            reg = TelemetryRegistry(clock=clock)
+            for chip in FakeTopology(hosts=1, mesh=(2, 2)).chips():
+                reg.put_capacity(chip.host, [chip.to_labels()])
+            eng = SchedulerEngine()
+            sync_engine_from_registry(eng, reg)
+            primary = Dispatcher(eng, reg, clock=clock)
+            pha = WarmStandby(primary, reg, "primary", ttl_s=TTL_S,
+                              clock=clock)
+            standby = Dispatcher(SchedulerEngine(), reg, clock=clock)
+            sha = WarmStandby(standby, reg, "standby", ttl_s=TTL_S,
+                              clock=clock)
+            assert pha.step() and not sha.step()
+            # both poll on the same cadence but at a seeded phase skew
+            skew = rng.uniform(0.0, ELECTION_POLL_S)
+            # the primary dies at a seeded phase inside its renew period
+            t_kill = clock.t + rng.uniform(0.0, ELECTION_POLL_S)
+            t_standby = clock.t + skew
+            clock.t = t_kill                   # primary never beats again
+            for _ in range(200):
+                t_standby += ELECTION_POLL_S
+                clock.t = t_standby
+                if sha.step():
+                    break
+            assert not standby.frozen, "takeover must unfreeze"
+            mttrs.append(clock.t - t_kill)
+    mttrs.sort()
+    return {"takeover_mttr_s_p50": round(_pct(mttrs, 0.50), 3),
+            "takeover_mttr_s_p99": round(_pct(mttrs, 0.99), 3),
+            "takeover_runs": len(mttrs)}
+
+
+def bench_registry_failover() -> dict:
+    """Seeded registry-leader kills: a supervisor probes the leader,
+    promotes the follower after PROBE_MISSES misses, and the write
+    plane reopens there. Plus steady-state replication lag."""
+    from kubeshare_tpu.ha import ReplicationFollower
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+
+    fail_windows, lags = [], []
+    for seed in SEEDS:
+        rng = random.Random(seed + 1000)
+        for _ in range(RUNS_PER_SEED):
+            clock = _TickClock()
+            leader = TelemetryRegistry(clock=clock)
+            follower = TelemetryRegistry(clock=clock)
+            repl = ReplicationFollower(follower, leader,
+                                       lag_bound_s=LAG_BOUND_S,
+                                       clock=clock)
+            # steady state: writes at seeded instants, follower polling
+            next_poll, epoch = clock.t, 0
+            for _ in range(50):
+                clock.t += rng.uniform(0.05, 0.4)
+                epoch += 1
+                leader.put_lease("n0", epoch)
+                wrote_at = clock.t
+                while next_poll < clock.t:
+                    next_poll += REPL_POLL_S
+                clock.t = next_poll
+                repl.step()
+                lags.append(clock.t - wrote_at)
+            # the kill: leader gone at a seeded phase inside the probe
+            t_kill = clock.t + rng.uniform(0.0, PROBE_S)
+            clock.t = t_kill
+            # supervisor probes miss PROBE_MISSES times, then promotes
+            t_probe = t_kill
+            for _ in range(PROBE_MISSES):
+                t_probe += PROBE_S
+            clock.t = t_probe
+            repl.promote()
+            ok, _ = follower.put_lease("n0", epoch + 1)
+            assert ok, "promoted follower must accept writes"
+            fail_windows.append(clock.t - t_kill)
+    fail_windows.sort()
+    lags.sort()
+    return {"registry_failover_s_p50": round(_pct(fail_windows, 0.50), 3),
+            "registry_failover_s_p99": round(_pct(fail_windows, 0.99), 3),
+            "replication_lag_s_p50": round(_pct(lags, 0.50), 4),
+            "replication_lag_s_p99": round(_pct(lags, 0.99), 4),
+            "replication_lag_bound_s": LAG_BOUND_S}
+
+
+def bench_fence_cost() -> dict:
+    """Wall-clock cost of the epoch fence check on put_pod: the delta
+    between fenced and unfenced writes, best of 3 batches (min-delta
+    suppresses scheduler noise)."""
+    from kubeshare_tpu.telemetry import TelemetryRegistry
+
+    N = 20_000
+    reg = TelemetryRegistry()
+    reg.acquire_leader("scheduler", "bench", 1, ttl_s=3600.0)
+    rec = {"node": "tpu-host-0"}
+    deltas = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(N):
+            reg.put_pod("ns/p", rec)
+        plain_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(N):
+            reg.put_pod("ns/p", rec, fence=1)
+        fenced_s = time.perf_counter() - t0
+        deltas.append((fenced_s - plain_s) / N)
+    overhead_us = max(0.0, min(deltas)) * 1e6
+    return {"fence_overhead_us": round(overhead_us, 4),
+            "fence_ops": N}
+
+
+def run_bench() -> dict:
+    logging.disable(logging.CRITICAL)    # the kills are deliberately noisy
+    out = {"bench": "control-plane HA: takeover MTTR, registry failover, "
+                    "replication lag (virtual clock) + fence cost (wall)",
+           "ttl_s": TTL_S, "seeds": list(SEEDS),
+           "runs_per_seed": RUNS_PER_SEED}
+    out.update(bench_takeover())
+    out.update(bench_registry_failover())
+    out.update(bench_fence_cost())
+    logging.disable(logging.NOTSET)
+    return out
+
+
+def check(out: dict) -> int:
+    """Acceptance bars (doc/ha.md): scheduler takeover p99 under 3x a
+    node-death detection, replication lag inside its advertised bound,
+    fencing invisible on the bind hot path."""
+    health = _health_baseline()
+    detect_p99 = float(health.get("detection_latency_s_p99", 17.5))
+    mttr_roof = 3.0 * detect_p99
+    checks_per_sec = float(health.get("admission_checks_per_sec", 20244))
+    fence_roof_us = 0.02 * 1e6 / checks_per_sec
+    bars = [
+        ("takeover_mttr_s_p99",
+         out["takeover_mttr_s_p99"] < mttr_roof,
+         f"scheduler takeover must beat 3x node-death detection "
+         f"({mttr_roof:g}s)"),
+        ("registry_failover_s_p99",
+         out["registry_failover_s_p99"] < mttr_roof,
+         f"registry failover must beat 3x node-death detection "
+         f"({mttr_roof:g}s)"),
+        ("replication_lag_s_p99",
+         out["replication_lag_s_p99"] <= out["replication_lag_bound_s"],
+         "steady-state lag must stay inside the advertised bound"),
+        ("fence_overhead_us",
+         out["fence_overhead_us"] <= fence_roof_us,
+         f"fence check must cost <=2% of one admission check "
+         f"({fence_roof_us:.2f}us)"),
+    ]
+    failed = [f"{name}: {why} (got {out.get(name)})"
+              for name, ok, why in bars if not ok]
+    for line in failed:
+        print(f"# CHECK FAILED {line}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+def _metric_keys(out: dict) -> list:
+    return ["takeover_mttr_s_p50", "takeover_mttr_s_p99",
+            "registry_failover_s_p50", "registry_failover_s_p99",
+            "replication_lag_s_p50", "replication_lag_s_p99",
+            "fence_overhead_us"]
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _metric_keys(fresh):
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:30s} {old!s:>8} -> {new!s:>8}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02 or (new == 0 and old == 0):
+            tag = "~same"
+        print(f"#   {key:30s} {old!s:>8} -> {new!s:>8}  "
+              f"({ratio:5.2f}x {tag})", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_failover")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the MTTR / lag / overhead "
+                             "bars hold")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    return check(out) if args.check else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

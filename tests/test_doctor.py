@@ -56,8 +56,8 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
     assert rc == 1
     # registry + fleetquery + scheduler + autopilot + rightsize +
     # serving + slo + invariants + gangs + ledger + preempt + prof +
-    # decisions + leases all refuse
-    assert out.count("fail") == 14
+    # decisions + ha + leases all refuse
+    assert out.count("fail") == 15
 
 
 def test_doctor_cli_subprocess():
@@ -125,8 +125,8 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
     assert rc == 1, out
     # registry + fleetquery + scheduler + autopilot + rightsize +
     # serving + slo + invariants + gangs + ledger + preempt + prof +
-    # decisions + leases all refuse
-    assert out.count("fail") == 14, out
+    # decisions + ha + leases all refuse
+    assert out.count("fail") == 15, out
 
 
 def test_doctor_serving_probe_skip_then_ok(capsys, monkeypatch):

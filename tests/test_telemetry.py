@@ -397,3 +397,36 @@ def test_heartbeat_suppression_injector(registry, client):
         assert "tpu-host-0" not in client.leases()["leases"]
     finally:
         install(None)
+
+
+def test_cross_leader_zombie_heartbeat_refused_409(tmp_path):
+    """The monotonic-epoch refusal survives a registry failover: an
+    epoch accepted by the OLD leader replicates to the follower, so
+    after promotion the NEW leader still refuses the zombie's stale
+    beat with 409 + the current epoch (doc/ha.md) — a heartbeat raced
+    across the takeover cannot resurrect a superseded incarnation."""
+    from kubeshare_tpu.ha import ReplicationFollower
+    from kubeshare_tpu.telemetry import Heartbeater
+
+    leader = TelemetryRegistry()
+    leader.serve()
+    follower = TelemetryRegistry(journal=str(tmp_path / "follower.jsonl"))
+    repl = ReplicationFollower(follower,
+                               RegistryClient("127.0.0.1", leader.port))
+    lc = RegistryClient("127.0.0.1", leader.port)
+    hb_old = Heartbeater(lc, "tpu-host-0", ttl_s=5.0)
+    assert hb_old.beat_once()
+    hb_new = Heartbeater(lc, "tpu-host-0", ttl_s=5.0)   # restarted agent
+    assert hb_new.beat_once()                           # supersedes
+    epoch = lc.leases()["leases"]["tpu-host-0"]["epoch"]
+    assert repl.step()                                  # epochs shipped
+    leader.close()
+    repl.promote()
+    follower.serve()
+    fc = RegistryClient("127.0.0.1", follower.port)
+    # the zombie's stale epoch is refused over the wire (HTTP 409)
+    # by the promoted registry, with the takeover hint attached
+    assert fc.put_lease("tpu-host-0", epoch - 1) == (False, epoch)
+    # while the live incarnation's next epoch keeps beating fine
+    assert fc.put_lease("tpu-host-0", epoch + 1) == (True, epoch + 1)
+    follower.close()
