@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-rightsize bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay bench-shard bench-failover image clean obs-check
+.PHONY: all native test bench bench-proxy bench-recovery bench-health bench-autopilot bench-rightsize bench-elastic bench-slo bench-serving bench-fleet bench-chaos bench-gang bench-contention bench-preempt bench-profile bench-replay bench-shard bench-failover image clean obs-check
 
 all: native
 
@@ -92,6 +92,14 @@ bench-autopilot:
 bench-rightsize:
 	JAX_PLATFORMS=cpu $(PY) scripts/bench_rightsize.py --check \
 		--baseline bench_rightsize.json --write bench_rightsize.json
+
+# Elastic-plane bench (doc/elastic.md): goodput across the 2->4->1
+# demand ramp vs the clairvoyant static oracle, resize pause p99 vs a
+# whole-gang migration flip, resize-mid-churn chaos seeds and the
+# disabled bit-identity bar, then refreshes bench_elastic.json.
+bench-elastic:
+	JAX_PLATFORMS=cpu $(PY) scripts/bench_elastic.py --check \
+		--baseline bench_elastic.json --write bench_elastic.json
 
 # SLO-plane micro-bench (doc/observability.md): evaluator cost per
 # observation, exemplar surcharge, and burn-to-alert detection latency

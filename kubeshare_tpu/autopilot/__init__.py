@@ -8,9 +8,10 @@ isolation plane's token scheduler, and reports through the obs plane.
 """
 
 from .controller import Autopilot
+from .cooldown import CooldownLedger
 from .elastic import ElasticQuota
 from .planner import Planner, fragmentation_score, fragmentation_view
 from .rebalancer import Rebalancer
 
-__all__ = ["Autopilot", "ElasticQuota", "Planner", "Rebalancer",
-           "fragmentation_score", "fragmentation_view"]
+__all__ = ["Autopilot", "CooldownLedger", "ElasticQuota", "Planner",
+           "Rebalancer", "fragmentation_score", "fragmentation_view"]

@@ -27,6 +27,7 @@ from ..obs import metrics as obs_metrics
 from ..topology.cell import reclaim_resource, reserve_resource
 from ..scheduler.scoring import select_cells
 from ..utils.logger import get_logger
+from .cooldown import CooldownLedger
 
 log = get_logger("autopilot")
 
@@ -96,28 +97,36 @@ class Planner:
 
     def __init__(self, dispatcher, budget: int = 8,
                  min_improvement: float = 0.05, cooldown_s: float = 120.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, cooldowns: CooldownLedger | None = None):
         self.dispatcher = dispatcher
         self.budget = budget
         self.min_improvement = min_improvement
-        self.cooldown_s = cooldown_s
         self._clock = clock
-        self._last_moved: dict[str, float] = {}
+        # One shared actuation rail (autopilot/cooldown.py): the
+        # rightsizer and elastic orchestrator hold the same ledger, so
+        # a move, share-change, and sub-mesh resize on one pod all
+        # observe one cooldown window.
+        self.cooldowns = cooldowns or CooldownLedger(
+            cooldown_s=cooldown_s, clock=clock)
+
+    @property
+    def cooldown_s(self) -> float:
+        return self.cooldowns.cooldown_s
 
     # -- cooldown bookkeeping (the rebalancer reports applied moves) ----
 
     def note_moved(self, key: str, now: float | None = None) -> None:
-        self._last_moved[key] = self._clock() if now is None else now
+        self.cooldowns.note(key, now)
 
     def _cooling(self, key: str, now: float) -> bool:
-        since = self._last_moved.get(key)
-        return since is not None and (now - since) < self.cooldown_s
+        return self.cooldowns.cooling(key, now)
 
     def cooling(self, key: str, now: float | None = None) -> bool:
-        """Public cooldown probe — the rightsizer shares this rail so a
-        just-moved pod is not immediately resized and a just-resized pod
-        is not immediately moved (doc/autopilot.md, Rightsizing)."""
-        return self._cooling(key, self._clock() if now is None else now)
+        """Public cooldown probe — the rightsizer and elastic plane
+        share this rail so a just-moved pod is not immediately resized
+        and a just-resized pod is not immediately moved
+        (doc/autopilot.md, Rightsizing)."""
+        return self.cooldowns.cooling(key, now)
 
     # -- candidate selection --------------------------------------------
 

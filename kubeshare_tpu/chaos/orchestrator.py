@@ -237,6 +237,7 @@ class ChaosRunner:
 
         self.autopilot = None
         self.rightsizer = None       # built lazily on rightsize_apply
+        self.elastic = None          # built lazily on resize_gang
         self._synth_end: dict = {}   # synthetic ledger chip -> last end
         self.preempt = None          # PreemptionPolicy once preempt_on
         self.token_scheds: dict = {}
@@ -371,6 +372,10 @@ class ChaosRunner:
                                   p.get("active_frac", 0.1)))
         elif act.action == "rightsize_apply":
             self._rightsize_cycle()
+        elif act.action == "resize_gang":
+            gang = (act.target if "/" in act.target
+                    else f"chaos/{act.target}")
+            self._elastic_resize(gang, int(p["target_chips"]))
         elif act.action == "preempt_on":
             from ..preempt import PreemptionPolicy
 
@@ -497,6 +502,42 @@ class ChaosRunner:
                 clock=self._clock)
         self._sync_token_scheds()
         self.rightsizer.cycle(now=self.now)
+
+    def _elastic_resize(self, gang: str, target_chips: int) -> None:
+        if self.elastic is None:
+            from ..elastic import ElasticConfig, ElasticOrchestrator
+
+            # chaos-speed rails: short cooldown so grow-then-shrink in
+            # one run is possible, short REAL-time pause bound — the
+            # single-threaded loop can't drain a non-idle gang, so a
+            # busy gang must refuse fast instead of hanging the run
+            cfg = ElasticConfig(pause_timeout_s=0.5, cooldown_s=0.2)
+            self.elastic = ElasticOrchestrator(
+                self.disp, gang_coordinator=self.gangcoord, cfg=cfg,
+                journal_path=os.path.join(self.workdir,
+                                          "elastic.jsonl"),
+                clock=self._clock)
+        # the loop is single-threaded, so a blocked pause() could never
+        # be notified: set the pause flag with a zero timeout (the gang
+        # STAYS paused on timeout by contract), then step the paused
+        # gang through a few future ticks — a held grant releases, an
+        # in-flight reserve completes-and-releases or expires, and no
+        # new grant starts while paused — so the resize's own pause is
+        # immediate
+        if not self.gangcoord.pause(gang, timeout=0.0):
+            for i in range(1, 13):
+                self.gangcoord.step(self.now + i * TICK_S)
+                states = {s["gang"]: s["state"]
+                          for s in self.gangcoord.grant_states(self.now)}
+                if states.get(gang, "idle") == "idle":
+                    break
+        self.elastic.resize(gang, target_chips, reason="chaos",
+                            now=self.now)
+        # unwind the pre-pause on plan-stage refusals (applied resizes
+        # already resumed inside the orchestrator; extra resume is a
+        # no-op)
+        self.gangcoord.resume(gang)
+        self._sync_token_scheds()
 
     def _serve_submit(self, tenant: str, count: int) -> None:
         import numpy as np

@@ -35,6 +35,13 @@ Action vocabulary (executed by ``orchestrator.ChaosRunner``):
 ``rightsize_apply``   run one rightsizer plan+apply cycle (shrinks,
                       rollback rails, pack moves — doc/autopilot.md,
                       Rightsizing)
+``resize_gang``       elastic-resize a running gang's sub-mesh to
+                      ``target_chips`` chips through the journaled
+                      plan→pause→restate→flip→resume machine
+                      (doc/elastic.md); target is the gang name in the
+                      ``chaos`` namespace (or a full ``ns/name``).
+                      Refusals (cooldown, no capacity mid-eviction) are
+                      recorded outcomes, not violations
 ``serve_submit``      admit serving requests (params: tenant, count)
 ``park`` / ``resume`` freeze a serving tenant into a manifest / replay it
 ``servable_crash``    the shared servable raises for the window (params:
@@ -339,6 +346,41 @@ def resize_mid_eviction(seed: int) -> Scenario:
         ])
 
 
+def resize_mid_churn(seed: int) -> Scenario:
+    """A live gang's sub-mesh is elastically grown and then shrunk
+    while the cluster churns around it — a host dies and returns and an
+    autopilot batch migrates across the same window.  The elastic flip
+    must never tear a member's booking or double-book a chip, the
+    gang-grant-atomicity invariant must hold through every pause/resume
+    (a refused resize — cooldown, no capacity mid-eviction — is an
+    outcome, not a violation), and the journal must land each resize as
+    exactly old-mesh or new-mesh (doc/elastic.md)."""
+    r = _rng("resize-mid-churn", seed)
+    grow_at = _j(r, 1.0)
+    return Scenario(
+        "resize-mid-churn",
+        "elastic gang grow+shrink racing node churn and autopilot",
+        [
+            # co-tenant singles contend for the chips the grow wants
+            ChaosAction(0.0, "submit", params={"count": 2, "request": 0.3}),
+            ChaosAction(0.1, "submit_gang",
+                        params={"name": "elastic-ring", "headcount": 4,
+                                "request": 0.5}),
+            ChaosAction(grow_at, "resize_gang", "elastic-ring",
+                        {"target_chips": 4}),
+            ChaosAction(_j(r, grow_at + 0.05, 0.1), "node_down",
+                        "host-1"),
+            ChaosAction(_j(r, grow_at + 0.5), "autopilot_apply"),
+            # shrink the survivor onto one chip while half the fleet is
+            # gone (may refuse on cooldown — an outcome, not a tear)
+            ChaosAction(_j(r, grow_at + 1.0), "resize_gang",
+                        "elastic-ring", {"target_chips": 1}),
+            ChaosAction(_j(r, grow_at + 3.0), "node_up", "host-1"),
+            ChaosAction(_j(r, grow_at + 4.0), "resize_gang",
+                        "elastic-ring", {"target_chips": 2}),
+        ])
+
+
 def registry_leader_kill_mid_bind_publish(seed: int) -> Scenario:
     """The registry leader is killed abruptly while bindings are being
     published — the follower promotes with whatever its cursor reached
@@ -400,6 +442,7 @@ BUILDERS = {
     "preemption-vs-migration": preemption_vs_migration,
     "cross-shard-gang-commit-fail": cross_shard_gang_commit_fail,
     "resize-mid-eviction": resize_mid_eviction,
+    "resize-mid-churn": resize_mid_churn,
     "registry-leader-kill-mid-bind-publish":
         registry_leader_kill_mid_bind_publish,
     "partition-with-standby-takeover": partition_with_standby_takeover,

@@ -252,6 +252,48 @@ def check_rightsize(addr: str, timeout_s: float,
         "booked/declared")
 
 
+def check_elastic(addr: str, timeout_s: float,
+                  defaulted: bool = False) -> bool:
+    """Elastic training-plane probe (doc/elastic.md): ``/elastic`` must
+    answer; a detached orchestrator is a skip (opt-in via
+    ``--elastic``). An attached one fails when rollbacks outnumber
+    applied resizes — plans keep passing trial-booking then dying at
+    restate or flip, which means every attempt pauses a live gang for
+    nothing."""
+    if not addr or addr == "none":
+        return _result("elastic", "skip", "--scheduler none")
+    try:
+        state = json.loads(_get(f"http://{addr}/elastic", timeout_s))
+    except Exception as exc:
+        if defaulted and _refused(exc) \
+                and not os.environ.get("KUBERNETES_SERVICE_HOST"):
+            return _result("elastic", "skip",
+                           f"{addr} refused (no cluster on this host)")
+        if "404" in str(exc):
+            return _result("elastic", "skip",
+                           "scheduler predates /elastic")
+        return _result("elastic", "fail", f"{addr}: {exc}")
+    if not state.get("attached"):
+        return _result("elastic", "skip",
+                       "not attached (start the scheduler with "
+                       "--elastic to enable)")
+    by = state.get("by_outcome") or {}
+    applied = by.get("applied", 0)
+    rolled = by.get("rolled_back", 0)
+    if rolled > max(applied, 0):
+        return _result(
+            "elastic", "fail",
+            f"{rolled} rolled-back resize(s) vs {applied} applied — "
+            "gangs are being paused for resizes that never land (see "
+            "the elastic journal)")
+    gangs = state.get("gangs") or {}
+    return _result(
+        "elastic", "ok",
+        f"{addr}: {'enabled' if state.get('enabled') else 'DISABLED'}, "
+        f"{state.get('resizes_total', 0)} resize(s), {applied} applied "
+        f"/ {rolled} rolled back, {len(gangs)} gang(s)")
+
+
 def check_serving(addr: str, timeout_s: float,
                   defaulted: bool = False) -> bool:
     """Serving-plane probe (doc/serving.md): ``/serving`` must answer;
@@ -791,6 +833,7 @@ def main(argv=None) -> int:
     ok &= check_scheduler(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_autopilot(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_rightsize(scheduler, 5.0, defaulted=sched_defaulted)
+    ok &= check_elastic(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_serving(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_slo(scheduler, 5.0, defaulted=sched_defaulted)
     ok &= check_invariants(scheduler, 5.0, defaulted=sched_defaulted)

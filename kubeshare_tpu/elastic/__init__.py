@@ -1,0 +1,33 @@
+"""Elastic SPMD training plane (doc/elastic.md): live grow/shrink of a
+running gang's sub-mesh without restart.
+
+Control plane (:mod:`.orchestrator`) — the journaled
+plan→pause→restate→flip→resume state machine over the dispatcher's
+bookings; import-light so the scheduler service, doctor and CLI can
+load it without JAX. Data plane (:mod:`.restate`, :mod:`.trainer`) —
+re-sharding live param/optimizer trees onto the new mesh; imported
+lazily because it pulls in JAX.
+
+Distinct from :class:`~..autopilot.elastic.ElasticQuota` (idle *share*
+lending within a fixed placement): this plane changes the placement
+itself — how many chips a training job runs on.
+"""
+
+from .orchestrator import (ElasticConfig, ElasticOrchestrator, recover)
+
+__all__ = ["ElasticConfig", "ElasticOrchestrator", "ElasticTrainer",
+           "recover", "restate_state", "restate_tree",
+           "restate_via_checkpoint"]
+
+
+def __getattr__(name):
+    # lazy: the data plane imports jax; the control plane must stay
+    # loadable in jax-free processes (service, doctor, topcli)
+    if name in ("restate_state", "restate_tree",
+                "restate_via_checkpoint"):
+        from . import restate
+        return getattr(restate, name)
+    if name == "ElasticTrainer":
+        from .trainer import ElasticTrainer
+        return ElasticTrainer
+    raise AttributeError(name)

@@ -91,6 +91,18 @@ def verify_shared_path(path: str | os.PathLike) -> None:
             f"shared volume (RWX) or drop --checkpoint.")
 
 
+def _pad_empty(x):
+    """Orbax/tensorstore cannot write zero-size arrays (the param entry
+    never lands in the kvstore and the save fails validation); stand in
+    a 1-element placeholder of the same dtype. The restore side rebuilds
+    empty leaves from the like-tree's shape+dtype alone — zero elements
+    carry no data."""
+    arr = x if isinstance(x, jax.Array) else np.asarray(x)
+    if arr.size == 0:
+        return np.zeros((1,), arr.dtype)
+    return x
+
+
 def _state_tree(params, opt_state, step: int) -> dict:
     """The saved pytree, shared by the sync and async save paths."""
     if jax.process_count() > 1:
@@ -104,7 +116,7 @@ def _state_tree(params, opt_state, step: int) -> dict:
                   for x in jax.tree_util.tree_leaves(
                       _materialize((params, opt_state)))]
         step_leaf = int(step)
-    return {"leaves": leaves, "step": step_leaf}
+    return {"leaves": [_pad_empty(x) for x in leaves], "step": step_leaf}
 
 
 def save_checkpoint(path: str | os.PathLike, params, opt_state,
@@ -219,6 +231,8 @@ def load_checkpoint(path: str | os.PathLike, like_params, like_opt_state):
     like_leaves = jax.tree_util.tree_leaves((like_params, like_opt_state))
     if jax.process_count() > 1:
         def abstract(x):
+            if np.size(x) == 0:          # matches _pad_empty's stand-in
+                return np.zeros((1,), np.asarray(x).dtype)
             if isinstance(x, jax.Array):
                 return jax.ShapeDtypeStruct(x.shape, x.dtype,
                                             sharding=x.sharding)
@@ -234,5 +248,10 @@ def load_checkpoint(path: str | os.PathLike, like_params, like_opt_state):
     treedef = jax.tree_util.tree_structure((like_params, like_opt_state))
     leaves = [state["leaves"][i] for i in range(len(state["leaves"]))] \
         if isinstance(state["leaves"], dict) else list(state["leaves"])
+    # zero-size leaves were saved as 1-element stand-ins (_pad_empty);
+    # their content is their shape+dtype, which the like-tree carries
+    leaves = [np.zeros(np.shape(like), np.asarray(like).dtype)
+              if np.size(like) == 0 else leaf
+              for leaf, like in zip(leaves, like_leaves)]
     params, opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
     return params, opt_state, int(state["step"])

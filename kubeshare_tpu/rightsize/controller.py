@@ -117,6 +117,12 @@ class RightsizeConfig:
     #: a packed pod stays put this long — consolidation must converge,
     #: not oscillate between sliver chips
     pack_cooldown_s: float = 600.0
+    #: propose elastic sub-mesh grows (doc/elastic.md) for gang tenants
+    #: whose fast-burn window is hot. Off by default: turning it on
+    #: lets the rightsizer scale training *jobs*, not just shares
+    elastic_grow: bool = False
+    #: chips added per elastic grow proposal
+    elastic_grow_chips: int = 1
 
 
 class Rightsizer:
@@ -128,13 +134,18 @@ class Rightsizer:
                  gang_coordinator=None, enabled: bool = True,
                  cfg: RightsizeConfig | None = None,
                  journal_path: str | None = None,
-                 clock=time.monotonic, tenant_fn=default_tenant):
+                 clock=time.monotonic, tenant_fn=default_tenant,
+                 cooldowns=None, elastic=None):
         """``schedulers`` maps chip_id -> TokenScheduler for the chips
         this process actuates directly (sim, chaos, tests; the live
         service's proxies learn the new share through the registry).
-        ``planner`` (shared with the autopilot when both planes are on)
-        owns the cooldown rail; ``rebalancer`` executes pack moves with
-        the autopilot's journaled gang-atomic semantics."""
+        ``cooldowns`` is the shared :class:`~..autopilot.cooldown.
+        CooldownLedger` actuation rail (defaults to the planner's, so
+        move / share-change / elastic resize on one pod observe one
+        window); ``rebalancer`` executes pack moves with the
+        autopilot's journaled gang-atomic semantics; ``elastic`` is the
+        orchestrator grow proposals actuate through when
+        ``cfg.elastic_grow`` is on."""
         from ..autopilot.planner import Planner
         from ..autopilot.rebalancer import Rebalancer
 
@@ -144,7 +155,9 @@ class Rightsizer:
         self.blame = blame
         self.planner = planner or Planner(
             dispatcher, cooldown_s=(cfg or RightsizeConfig()).cooldown_s,
-            clock=clock)
+            clock=clock, cooldowns=cooldowns)
+        self.cooldowns = cooldowns or self.planner.cooldowns
+        self.elastic = elastic
         self.rebalancer = rebalancer or Rebalancer(
             dispatcher, planner=self.planner,
             gang_coordinator=gang_coordinator)
@@ -285,6 +298,19 @@ class Rightsizer:
         chip = pod.bookings[0][0]
         return self.gang_coordinator.gang_for(chip, pod.key) or ""
 
+    @staticmethod
+    def _gang_shape(eng, gang: str) -> tuple[int, int]:
+        """(distinct booked chips, member count) of *gang* — the
+        from/ceiling of an elastic grow proposal. Caller holds the
+        dispatcher lock."""
+        chips: set[str] = set()
+        members = 0
+        for p in eng.pod_status.values():
+            if p.group_name and p.group_key == gang and p.bookings:
+                members += 1
+                chips.update(b[0] for b in p.bookings)
+        return len(chips), members
+
     # -- planning --------------------------------------------------------
 
     def plan(self, now: float | None = None) -> dict:
@@ -341,7 +367,7 @@ class Rightsizer:
                                     "reason": "hysteresis"})
                     _SKIPPED.inc("hysteresis")
                     continue
-                if any(self.planner.cooling(p.key, now) for p in pods):
+                if any(self.cooldowns.cooling(p.key, now) for p in pods):
                     skipped.append({"tenant": tenant,
                                     "reason": "cooldown"})
                     _SKIPPED.inc("cooldown")
@@ -411,6 +437,8 @@ class Rightsizer:
                         proposed=round(current - freed, 6), reason=why)
             squeezed: set[str] = set(t for t in picked
                                      if targets[t][1] < targets[t][0])
+            elastic_props: list[dict] = []
+            elastic_seen: set[str] = set()
             for tenant in picked:
                 current, target, why = targets[tenant]
                 if target <= current:
@@ -435,6 +463,23 @@ class Rightsizer:
                             "mode": "effective-only", "gang": gang})
                         _RESIZES.inc("grow", "planned")
                         grown += need
+                        # elastic grow (doc/elastic.md, off by default):
+                        # a hot gang tenant gets a whole extra chip,
+                        # not just a fatter token window — the fast-burn
+                        # gate already admitted it into the grow set
+                        if cfg.elastic_grow and gang not in elastic_seen:
+                            elastic_seen.add(gang)
+                            cur_chips, members = self._gang_shape(
+                                eng, gang)
+                            to_chips = min(
+                                members,
+                                cur_chips + cfg.elastic_grow_chips)
+                            if to_chips > cur_chips:
+                                elastic_props.append({
+                                    "gang": gang, "tenant": tenant,
+                                    "from_chips": cur_chips,
+                                    "to_chips": to_chips,
+                                    "reason": why})
                         continue
                     if chip_free(chip) + 1e-9 < need \
                             and self.blame is not None:
@@ -451,7 +496,7 @@ class Rightsizer:
                                 (p for p in by_tenant.get(nb, [])
                                  if p.bookings[0][0] == chip
                                  and not p.group_name), None)
-                            if nb_pod is None or self.planner.cooling(
+                            if nb_pod is None or self.cooldowns.cooling(
                                     nb_pod.key, now):
                                 continue
                             # same rails as a voluntary shrink: never
@@ -525,6 +570,11 @@ class Rightsizer:
                 "moves": moves, "skipped": skipped,
                 "tenants": tenants_view,
                 "chip_equivalents": chip_equiv}
+        if cfg.elastic_grow:
+            # key present only behind the flag: the off-path plan (and
+            # decision stream below) stays bit-identical to a build
+            # without the elastic plane
+            plan["elastic"] = elastic_props
         _PLAN_LAT.observe(value=time.perf_counter() - t0)
         tracer = get_tracer()
         tracer.record("rightsize-plan", "", tracer.now_ms(),
@@ -532,13 +582,18 @@ class Rightsizer:
                       moves=len(moves))
         dec = getattr(self.dispatcher, "decisions", None)
         if dec is not None:
+            extra = {}
+            if cfg.elastic_grow:
+                extra["elastic"] = [
+                    {"gang": p["gang"], "to_chips": p["to_chips"],
+                     "reason": p["reason"]} for p in elastic_props]
             dec.record("rightsize-plan", now,
                        resizes=[{"pod": r["pod"], "from": r["from"],
                                  "to": r["to"], "reason": r["reason"]}
                                 for r in resizes],
                        moves=[{"pod": m["pod"], "from": m["from"],
                                "node": m["node"]} for m in moves],
-                       chip_equivalents=chip_equiv)
+                       chip_equivalents=chip_equiv, **extra)
         self.last_plan = plan
         return plan
 
@@ -589,7 +644,7 @@ class Rightsizer:
                         now - last < cfg.pack_cooldown_s:
                     _SKIPPED.inc("pack-cooldown")
                     continue
-                if self.planner.cooling(pod.key, now):
+                if self.cooldowns.cooling(pod.key, now):
                     _SKIPPED.inc("cooldown")
                     continue
                 mplan = self.dispatcher.plan_migration(pod.key, exclude)
@@ -686,7 +741,7 @@ class Rightsizer:
             self._journal({"event": "resize_done", "batch": batch,
                            "pod": rec["pod"], "to": rec["to"]})
         for rec in done:
-            self.planner.note_moved(rec["pod"], now)
+            self.cooldowns.note(rec["pod"], now)
             if rec["to"] < rec["from"]:
                 self._last_shrunk[rec["tenant"]] = now
             result["applied"].append(rec)
@@ -698,14 +753,31 @@ class Rightsizer:
         moves = list(plan.get("moves", []))
         if moves and not result["failed"]:
             result["moves"] = self.rebalancer.apply({"moves": moves})
+        props = list(plan.get("elastic", []))
+        if props and not result["failed"] and self.cfg.elastic_grow \
+                and self.elastic is not None:
+            # whole-gang grows run through the elastic orchestrator's
+            # own journaled state machine; it records and cools each
+            # member itself, so a refused resize costs nothing here
+            result["elastic"] = []
+            for pr in props:
+                out = self.elastic.resize(pr["gang"], pr["to_chips"],
+                                          reason="rightsize-grow")
+                result["elastic"].append(
+                    {"gang": pr["gang"],
+                     "outcome": out.get("outcome", "error")})
         dec = getattr(self.dispatcher, "decisions", None)
         if dec is not None:
+            extra = {}
+            if self.cfg.elastic_grow:
+                extra["elastic"] = list(result.get("elastic", []))
             dec.record("rightsize-apply", now,
                        applied=[r["pod"] for r in result["applied"]],
                        rolled_back=[r["pod"]
                                     for r in result["rolled_back"]],
                        failed=[r["pod"] for r in result["failed"]],
-                       moves=(result["moves"] or {}).get("applied", []))
+                       moves=(result["moves"] or {}).get("applied", []),
+                       **extra)
         self.last_apply = result
         return result
 

@@ -310,6 +310,24 @@ class ServiceClient:
             raise RuntimeError(f"/rightsize returned {code}")
         return body
 
+    def elastic(self) -> dict:
+        """Elastic training-plane snapshot (``GET /elastic``,
+        doc/elastic.md): per-gang mesh shape, last resize, pause
+        percentiles; ``{"attached": false}`` when the plane is off,
+        RuntimeError when the scheduler predates it."""
+        code, body = self._call("GET", "/elastic")
+        if code != 200:
+            raise RuntimeError(f"/elastic returned {code}")
+        return body
+
+    def elastic_resize(self, gang: str, target_chips: int,
+                       reason: str = "operator") -> tuple[int, dict]:
+        """``POST /elastic/resize`` — returns (status, body); 409
+        carries the refusal reason."""
+        return self._call("POST", "/elastic/resize",
+                          {"gang": gang, "target_chips": target_chips,
+                           "reason": reason}, idempotent=False)
+
     def serving(self) -> dict:
         """Serving front-door join view (``GET /serving``,
         doc/serving.md); ``{"attached": false}`` when no front door is

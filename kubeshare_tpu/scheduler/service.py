@@ -158,6 +158,7 @@ class SchedulerService:
         self._server: ThreadingHTTPServer | None = None
         self.autopilot = None
         self.rightsizer = None
+        self.elastic = None
         self.serving = None
         self.remote_write = None
         # control-plane HA (doc/ha.md): None until attach_standby —
@@ -196,6 +197,13 @@ class SchedulerService:
         ``self.dispatcher`` (doc/autopilot.md, Rightsizing); exposes it
         on ``/rightsize``."""
         self.rightsizer = rightsizer
+        return self
+
+    def attach_elastic(self, orchestrator) -> "SchedulerService":
+        """Wire an :class:`~..elastic.ElasticOrchestrator` built over
+        ``self.dispatcher`` (doc/elastic.md); exposes it on
+        ``/elastic`` (GET = snapshot, POST /elastic/resize)."""
+        self.elastic = orchestrator
         return self
 
     def attach_serving(self, frontdoor) -> "SchedulerService":
@@ -297,6 +305,12 @@ class SchedulerService:
         if self.rightsizer is None:
             return {"attached": False, "enabled": False}
         return self.rightsizer.snapshot()
+
+    def elastic_state(self) -> dict:
+        """``GET /elastic`` body; cheap when no orchestrator is wired."""
+        if self.elastic is None:
+            return {"attached": False, "enabled": False}
+        return self.elastic.snapshot()
 
     def serving_state(self) -> dict:
         """``GET /serving`` body; cheap when no front door is wired."""
@@ -525,6 +539,8 @@ class SchedulerService:
                     return self._reply(200, svc.autopilot_state())
                 if self.path == "/rightsize":
                     return self._reply(200, svc.rightsize_state())
+                if self.path == "/elastic":
+                    return self._reply(200, svc.elastic_state())
                 if self.path == "/serving":
                     return self._reply(200, svc.serving_state())
                 if self.path == "/slo":
@@ -591,6 +607,16 @@ class SchedulerService:
                             return self._reply(
                                 409, {"error": "rightsizer not attached"})
                         return self._reply(200, svc.rightsizer.cycle())
+                    if self.path == "/elastic/resize":
+                        if svc.elastic is None:
+                            return self._reply(
+                                409, {"error": "elastic not attached"})
+                        out = svc.elastic.resize(
+                            body["gang"], int(body["target_chips"]),
+                            reason=body.get("reason", "operator"))
+                        code = (200 if out.get("outcome")
+                                in ("applied", "noop") else 409)
+                        return self._reply(code, out)
                 except (LabelError, Unschedulable) as e:
                     return self._reply(409, {"error": str(e)})
                 except Exception as e:
@@ -708,6 +734,20 @@ def main(argv=None) -> None:
     parser.add_argument("--rightsize-journal", default="",
                         help="JSONL resize journal path; empty = no "
                              "journal")
+    parser.add_argument("--elastic", action="store_true",
+                        help="attach the elastic SPMD training plane: "
+                             "live gang sub-mesh grow/shrink on "
+                             "/elastic + /elastic/resize "
+                             "(doc/elastic.md)")
+    parser.add_argument("--elastic-journal", default="",
+                        help="elastic resize JSONL journal path (the "
+                             "crash-recovery commit log); empty = no "
+                             "journal")
+    parser.add_argument("--elastic-grow", action="store_true",
+                        help="with --rightsize and --elastic: let the "
+                             "rightsizer propose whole-chip gang grows "
+                             "through the elastic plane (off by "
+                             "default)")
     parser.add_argument("--flight-dump-dir", default="",
                         help="persist flight-recorder black-box dumps as "
                              "JSONL files here (in-memory only when empty)")
@@ -774,13 +814,20 @@ def main(argv=None) -> None:
         shards=args.shards, shard_route=args.shard_route,
         max_pending=args.max_pending or None)
     planner = rebalancer = None
-    if args.autopilot or args.rightsize:
+    cooldowns = None
+    if args.autopilot or args.rightsize or args.elastic:
         # the cooldown rail is SHARED: a pod the autopilot just moved
-        # must not be immediately resized, and vice versa — one planner
-        # (and one journaled rebalancer) backs both planes
+        # must not be immediately resized or elastically re-homed, and
+        # vice versa — one ledger (and one planner / one journaled
+        # rebalancer) backs all three planes
+        from ..autopilot import CooldownLedger
+
+        cooldowns = CooldownLedger()
+    if args.autopilot or args.rightsize:
         from ..autopilot import Planner, Rebalancer
 
-        planner = Planner(svc.dispatcher, budget=args.autopilot_budget)
+        planner = Planner(svc.dispatcher, budget=args.autopilot_budget,
+                          cooldowns=cooldowns)
         rebalancer = Rebalancer(svc.dispatcher, planner=planner,
                                 journal_path=(args.autopilot_journal
                                               or None),
@@ -790,13 +837,23 @@ def main(argv=None) -> None:
 
         svc.attach_autopilot(Autopilot(
             svc.dispatcher, planner=planner, rebalancer=rebalancer))
-    if args.rightsize:
-        from ..rightsize import Rightsizer
+    if args.elastic:
+        from ..elastic import ElasticOrchestrator
 
+        svc.attach_elastic(ElasticOrchestrator(
+            svc.dispatcher, gang_coordinator=svc.gangcoord,
+            cooldowns=cooldowns,
+            journal_path=(args.elastic_journal or None)))
+    if args.rightsize:
+        from ..rightsize import Rightsizer, RightsizeConfig
+
+        cfg = RightsizeConfig(
+            elastic_grow=bool(args.elastic_grow and args.elastic))
         svc.attach_rightsize(Rightsizer(
             svc.dispatcher, slo=svc.slo, ledger=svc.ledger,
             blame=svc.blame, planner=planner, rebalancer=rebalancer,
-            gang_coordinator=svc.gangcoord,
+            gang_coordinator=svc.gangcoord, cfg=cfg,
+            cooldowns=cooldowns, elastic=svc.elastic,
             journal_path=(args.rightsize_journal or None)))
     if args.preempt:
         from ..preempt import PreemptionPolicy

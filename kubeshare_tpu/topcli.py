@@ -388,6 +388,76 @@ def render_rightsize(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def elastic_snapshot(client: RegistryClient, scheduler=None) -> dict:
+    """Elastic training-plane join (doc/elastic.md): the scheduler's
+    ``/elastic`` state — per-gang mesh shape, last resize, pause
+    percentiles — over the registry's capacity view, so the sub-mesh a
+    gang runs on and the fleet it could grow into are one frame."""
+    state: dict = {}
+    if scheduler is not None:
+        try:
+            state = scheduler.elastic()
+        except Exception as exc:
+            print(f"kubeshare-top: scheduler unreachable ({exc}) — "
+                  "elastic state unavailable, showing capacity only",
+                  file=sys.stderr)
+    chips = 0
+    try:
+        capacity = client.capacity()
+        chips = sum(len(e.get("chips", [])) for e in capacity.values())
+    except Exception:
+        pass
+    return {"elastic": state or {"attached": False, "enabled": False},
+            "chips": chips}
+
+
+def render_elastic(snap: dict) -> str:
+    el = snap["elastic"]
+    lines = ["ELASTIC (live gang sub-mesh resize, doc/elastic.md)"]
+    if not el.get("attached"):
+        lines.append("  not attached — start the scheduler with "
+                     "--elastic (or attach_elastic)")
+        if snap.get("chips"):
+            lines.append(f"  fleet: {snap['chips']} chips")
+        return "\n".join(lines)
+    by = el.get("by_outcome") or {}
+    outcomes = "  ".join(f"{k} {v}" for k, v in sorted(by.items()))
+    lines.append(
+        f"  {'enabled' if el.get('enabled') else 'DISABLED'}  "
+        f"resizes {el.get('resizes_total', 0)}"
+        + (f"  ({outcomes})" if outcomes else ""))
+    gangs = el.get("gangs") or {}
+    if gangs:
+        lines.append(
+            f"  {'gang':<24} {'chips':>5} {'members':>7} "
+            f"{'pause p50/p99 ms':>17}  last resize")
+        for name in sorted(gangs):
+            g = gangs[name]
+            last = g.get("last_resize") or {}
+            if last:
+                desc = (f"{last.get('from_chips', '?')} -> "
+                        f"{last.get('to_chips', '?')} "
+                        f"[{last.get('outcome')}"
+                        + (f": {last['reason']}"
+                           if last.get("reason") else "") + "]")
+            else:
+                desc = "-"
+            lines.append(
+                f"  {name:<24} {g.get('chips', 0):>5} "
+                f"{g.get('members', 0):>7} "
+                f"{g.get('pause_p50_ms', 0.0):>8.1f}/"
+                f"{g.get('pause_p99_ms', 0.0):<8.1f}  {desc}")
+        for name in sorted(gangs):
+            layout = gangs[name].get("layout")
+            if layout:
+                lines.append(f"  mesh {name}: {layout}")
+    cooling = (el.get("cooldowns") or {}).get("cooling") or {}
+    if cooling:
+        lines.append("  cooling: " + ", ".join(
+            f"{k} ({v:.0f}s)" for k, v in sorted(cooling.items())))
+    return "\n".join(lines)
+
+
 def serving_snapshot(client: RegistryClient, scheduler=None) -> dict:
     """Serving join (doc/serving.md): the scheduler's ``/serving`` view
     (per-tenant queue depth, admit/shed totals, p50/p99) over the
@@ -1387,6 +1457,11 @@ def main(argv=None) -> int:
                              "proposed share and decision reason (needs "
                              "--scheduler for /rightsize state) instead "
                              "of the fleet table")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic training-plane join: per-gang "
+                             "mesh shape, last resize and pause p50/p99 "
+                             "(needs --scheduler for /elastic state) "
+                             "instead of the fleet table")
     parser.add_argument("--serving", action="store_true",
                         help="serving front-door join: per-tenant queue "
                              "depth, admit/shed rates and p50/p99 (needs "
@@ -1496,6 +1571,10 @@ def main(argv=None) -> int:
                     rzs = rightsize_snapshot(client, scheduler)
                     out = (json.dumps(rzs) if args.json
                            else render_rightsize(rzs))
+                elif args.elastic:
+                    els = elastic_snapshot(client, scheduler)
+                    out = (json.dumps(els) if args.json
+                           else render_elastic(els))
                 elif args.serving:
                     svs = serving_snapshot(client, scheduler)
                     out = (json.dumps(svs) if args.json

@@ -1,0 +1,243 @@
+"""Elastic plane bench: live resize cost vs the static oracle
+(doc/elastic.md).
+
+The elastic training plane promises one measurable trade: a running
+gang follows a demand ramp (grow on burn, shrink on idle) with a pause
+cost small enough that chasing demand beats any static allocation a
+human would pick — and with zero torn bookings under churn. This bench
+puts numbers on it:
+
+- ``goodput_ratio``: useful chip-seconds across the default 2 → 4 → 1
+  demand ramp (seeded virtual-time sim, real dispatcher/coordinator/
+  orchestrator) against the clairvoyant static oracle that holds
+  exactly the demanded chips in every phase for free. Bar: >= 0.9.
+- ``pause_p99_ms`` vs ``migration_flip_p99_ms``: wall-clock p99 of a
+  full elastic resize (plan → pause → flip → resume, measured on a
+  live gang bounced 2↔4 chips) against a whole-gang migration flip —
+  one ``apply_move`` per member, the batch the autopilot would issue
+  to move the same gang — in the same process on the same fleet.
+  Bar: pause p99 <= 2x the migration flip p99 — the journaled
+  machine may not cost more than double the primitives it composes.
+- ``chaos_violations``: the ``resize-mid-churn`` nemesis (elastic
+  grow+shrink racing node churn and an autopilot batch) at seeds
+  3/11/23 — bar: 0 invariant violations, all runs converged.
+- ``static_decision_stream_clean``: the disabled orchestrator records
+  nothing — the decision stream is bit-identical to a build without
+  the plane (replay/shadow gate).
+- ``deterministic``: the elastic sim is byte-identical across two runs
+  with the same seed.
+
+Run: ``python scripts/bench_elastic.py`` → one JSON object (committed
+as ``bench_elastic.json``). ``--baseline FILE`` prints deltas;
+``--write FILE`` saves fresh numbers (``make bench-elastic`` does
+both). ``--check`` exits non-zero unless the bars hold (the CI
+``elastic-smoke`` job).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: keys worth a delta line (the rest of the JSON is descriptive)
+_METRICS = ("goodput_ratio", "pause_p99_ms", "migration_flip_p99_ms",
+            "resizes_applied", "chaos_runs")
+#: metrics where larger is better (the rest: smaller == cheaper flips)
+_HIGHER_IS_BETTER = ("goodput_ratio", "resizes_applied", "chaos_runs")
+
+#: the seeded scenario — keep in lockstep with tests/test_elastic.py
+#: and the CI elastic-smoke step (.github/workflows/ci.yml)
+SEED, CHAOS_SEEDS, FLIPS = 7, (3, 11, 23), 24
+
+
+def _pctl(samples, q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _time_flips() -> dict:
+    """Wall-clock the elastic resize against the migration-flip
+    primitive it composes, same process, same fleet."""
+    from kubeshare_tpu import constants as C
+    from kubeshare_tpu.autopilot.cooldown import CooldownLedger
+    from kubeshare_tpu.elastic import ElasticConfig, ElasticOrchestrator
+    from kubeshare_tpu.gang import GangTokenCoordinator
+    from kubeshare_tpu.scheduler.dispatcher import Dispatcher
+    from kubeshare_tpu.scheduler.engine import SchedulerEngine
+    from kubeshare_tpu.topology.discovery import FakeTopology
+
+    engine = SchedulerEngine()
+    by_host: dict = {}
+    for chip in FakeTopology(hosts=2, mesh=(2, 2)).chips():
+        by_host.setdefault(chip.host, []).append(chip)
+    for host, chips in sorted(by_host.items()):
+        engine.add_node(host, chips)
+    disp = Dispatcher(engine)
+    gangcoord = GangTokenCoordinator()
+    disp.attach_gang_coordinator(gangcoord)
+    labels = {C.POD_TPU_REQUEST: "0.25", C.POD_TPU_LIMIT: "1.0",
+              C.POD_GROUP_NAME: "bench", C.POD_GROUP_HEADCOUNT: "4",
+              C.POD_GROUP_THRESHOLD: "1.0"}
+    for i in range(4):
+        disp.submit("bench", f"bench-{i}", dict(labels))
+    disp.step(0.0)
+    orch = ElasticOrchestrator(
+        disp, gang_coordinator=gangcoord,
+        cooldowns=CooldownLedger(cooldown_s=0.0),
+        cfg=ElasticConfig(pause_timeout_s=5.0))
+
+    pause_s: list[float] = []
+    for i in range(FLIPS):
+        target = 4 if i % 2 == 0 else 2
+        t0 = time.perf_counter()
+        out = orch.resize("bench/bench", target, reason="bench")
+        dt = time.perf_counter() - t0
+        if out.get("outcome") == "applied":
+            pause_s.append(dt)
+
+    # whole-gang migration flip: the apply_move batch the autopilot
+    # would issue to shift the same gang host-0 <-> host-1
+    flip_s: list[float] = []
+    nodes = sorted(by_host)
+    for i in range(FLIPS):
+        dst = nodes[(i + 1) % 2]
+        t0 = time.perf_counter()
+        try:
+            for m in range(4):
+                disp.apply_move(f"bench/bench-{m}", dst)
+        except Exception:
+            continue
+        flip_s.append(time.perf_counter() - t0)
+
+    return {
+        "resize_flips_applied": len(pause_s),
+        "migration_flips_applied": len(flip_s),
+        "pause_p50_ms": round(_pctl(pause_s, 0.50) * 1e3, 3),
+        "pause_p99_ms": round(_pctl(pause_s, 0.99) * 1e3, 3),
+        "migration_flip_p50_ms": round(_pctl(flip_s, 0.50) * 1e3, 3),
+        "migration_flip_p99_ms": round(_pctl(flip_s, 0.99) * 1e3, 3),
+    }
+
+
+def run_bench() -> dict:
+    from kubeshare_tpu.chaos import run_scenario
+    from kubeshare_tpu.elastic.sim import simulate_elastic
+
+    sized = simulate_elastic(seed=SEED, elastic=True)
+    again = simulate_elastic(seed=SEED, elastic=True)
+    disabled = simulate_elastic(seed=SEED, elastic=False)
+    unattached = simulate_elastic(seed=SEED, attach=False)
+    flips = _time_flips()
+
+    chaos_violations = 0
+    chaos_converged = True
+    for seed in CHAOS_SEEDS:
+        rep = run_scenario("resize-mid-churn", seed=seed)
+        chaos_violations += len(rep["violations"])
+        chaos_converged = chaos_converged and rep["converged"]
+
+    return {
+        "bench": "elastic plane: live gang resize vs static oracle "
+                 "(seeded ramp, virtual clock; wall-clock flips)",
+        "seed": SEED, "chaos_seeds": list(CHAOS_SEEDS),
+        "ramp": sized["ramp"],
+        "goodput_ratio": sized["goodput_ratio"],
+        "static_goodput_ratio": disabled["goodput_ratio"],
+        "resizes_applied": sized["resizes_applied"],
+        "chips": sized["chips"],
+        **flips,
+        "chaos_runs": len(CHAOS_SEEDS),
+        "chaos_violations": chaos_violations,
+        "chaos_converged": chaos_converged,
+        "static_decision_stream_clean":
+            disabled["decision_kinds"] == unattached["decision_kinds"]
+            and not any(k.startswith("elastic")
+                        for k in disabled["decision_kinds"]),
+        "deterministic": json.dumps(sized, sort_keys=True)
+        == json.dumps(again, sort_keys=True),
+    }
+
+
+def check(out: dict) -> int:
+    """The CI elastic smoke (doc/elastic.md acceptance bars)."""
+    pause_bar = 2.0 * max(out["migration_flip_p99_ms"], 0.001)
+    bars = (
+        ("goodput_ratio", out["goodput_ratio"], ">= 0.9",
+         out["goodput_ratio"] >= 0.9),
+        ("resizes_applied", out["resizes_applied"], ">= 3",
+         out["resizes_applied"] >= 3),
+        ("pause_p99_ms", out["pause_p99_ms"],
+         f"<= 2x migration flip ({pause_bar:.3f})",
+         out["pause_p99_ms"] <= pause_bar),
+        ("chaos_violations", out["chaos_violations"], "== 0",
+         out["chaos_violations"] == 0),
+        ("chaos_converged", out["chaos_converged"], "== True",
+         out["chaos_converged"] is True),
+        ("static_decision_stream_clean",
+         out["static_decision_stream_clean"], "== True",
+         out["static_decision_stream_clean"] is True),
+        ("deterministic", out["deterministic"], "== True",
+         out["deterministic"] is True),
+    )
+    failed = 0
+    for name, value, bar, ok in bars:
+        print(f"# {'ok' if ok else 'FAIL'}: {name} = {value} (want {bar})",
+              file=sys.stderr)
+        failed += 0 if ok else 1
+    return 1 if failed else 0
+
+
+def print_deltas(fresh: dict, baseline_path: Path) -> None:
+    try:
+        base = json.loads(baseline_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"# no usable baseline at {baseline_path}: {e}",
+              file=sys.stderr)
+        return
+    print(f"# deltas vs {baseline_path}:", file=sys.stderr)
+    for key in _METRICS:
+        new, old = fresh.get(key), base.get(key)
+        if new is None or old is None:
+            print(f"#   {key:30s} {old!s:>10} -> {new!s:>10}",
+                  file=sys.stderr)
+            continue
+        ratio = (new / old) if old else float("inf")
+        better = (ratio >= 1.0) == (key in _HIGHER_IS_BETTER)
+        tag = "better" if better else "worse"
+        if abs(ratio - 1.0) < 0.02:
+            tag = "~same"
+        print(f"#   {key:30s} {old:>10} -> {new:>10}  ({ratio:5.2f}x {tag})",
+              file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench_elastic")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="committed baseline JSON to print deltas "
+                             "against (stderr)")
+    parser.add_argument("--write", type=Path, default=None,
+                        help="write the fresh numbers to this JSON file")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 unless the goodput/pause/chaos "
+                             "acceptance bars hold (the CI smoke)")
+    args = parser.parse_args(argv)
+    out = run_bench()
+    print(json.dumps(out, indent=2))
+    if args.baseline is not None:
+        print_deltas(out, args.baseline)
+    if args.write is not None:
+        args.write.write_text(json.dumps(out, indent=2) + "\n")
+    if args.check:
+        return check(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

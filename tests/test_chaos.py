@@ -487,7 +487,7 @@ def test_scenario_builders_are_seed_deterministic():
     assert any([a.to_dict() for a in build(name, 13).actions]
                != [a.to_dict() for a in build(name, 14).actions]
                for name in BUILDERS)
-    assert len(all_scenarios(0)) == len(BUILDERS) == 12  # + the HA pair
+    assert len(all_scenarios(0)) == len(BUILDERS) == 13  # + HA + elastic
 
 
 def test_partitioned_registry_fails_calls_during_window():
@@ -520,7 +520,7 @@ def test_run_suite_zero_violations_full_convergence():
     report = run_suite(seed=3)
     assert report["invariant_violations"] == 0
     assert report["converged"]
-    assert len(report["scenarios"]) == 12
+    assert len(report["scenarios"]) == 13
     for scn in report["scenarios"]:
         assert scn["converged"], scn["scenario"]
         assert scn["violations"] == [], scn["scenario"]

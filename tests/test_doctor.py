@@ -55,9 +55,9 @@ def test_doctor_fails_loudly_on_dead_endpoints(capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 1
     # registry + fleetquery + scheduler + autopilot + rightsize +
-    # serving + slo + invariants + gangs + ledger + preempt + prof +
-    # decisions + ha + leases all refuse
-    assert out.count("fail") == 15
+    # elastic + serving + slo + invariants + gangs + ledger + preempt +
+    # prof + decisions + ha + leases all refuse
+    assert out.count("fail") == 16
 
 
 def test_doctor_cli_subprocess():
@@ -124,9 +124,9 @@ def test_doctor_explicit_flags_fail_loudly(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 1, out
     # registry + fleetquery + scheduler + autopilot + rightsize +
-    # serving + slo + invariants + gangs + ledger + preempt + prof +
-    # decisions + ha + leases all refuse
-    assert out.count("fail") == 15, out
+    # elastic + serving + slo + invariants + gangs + ledger + preempt +
+    # prof + decisions + ha + leases all refuse
+    assert out.count("fail") == 16, out
 
 
 def test_doctor_serving_probe_skip_then_ok(capsys, monkeypatch):

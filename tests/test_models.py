@@ -125,6 +125,81 @@ def test_checkpoint_save_resume_roundtrip(tmp_path):
         load_checkpoint(tmp_path / "nope", like_p, optimizer.init(like_p))
 
 
+def _roundtrip(tmp_path, params, opt_state, step=5):
+    """Save → load against like-trees with garbage values; return the
+    loaded (params, opt_state)."""
+    import numpy as np
+
+    from kubeshare_tpu.models.checkpoint import (load_checkpoint,
+                                                 save_checkpoint)
+
+    save_checkpoint(tmp_path / "ckpt", params, opt_state, step=step)
+    like_p = jax.tree_util.tree_map(jnp.zeros_like, params)
+    like_s = jax.tree_util.tree_map(jnp.zeros_like, opt_state)
+    p, s, at = load_checkpoint(tmp_path / "ckpt", like_p, like_s)
+    assert at == step
+    for a, b in zip(jax.tree_util.tree_leaves(opt_state),
+                    jax.tree_util.tree_leaves(s)):
+        assert a.dtype == b.dtype, "slot dtype must survive the trip"
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(p)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    return p, s
+
+
+def test_checkpoint_roundtrips_momentum_slots(tmp_path):
+    """SGD momentum trace mirrors the param tree — the elastic restate
+    fallback (doc/elastic.md) relies on these slots surviving a disk
+    round-trip bit-exact."""
+    from kubeshare_tpu.models import tinymlp
+
+    params = tinymlp.init(jax.random.PRNGKey(0))
+    optimizer = optax.sgd(1e-2, momentum=0.9)
+    state = optimizer.init(params)
+    step = make_train_step(tinymlp.loss_fn, optimizer)
+    for i in range(3):   # non-trivial trace values
+        params, state, _ = step(params, state,
+                                tinymlp.batch_fn(jax.random.PRNGKey(i)))
+    _roundtrip(tmp_path, params, state)
+
+
+def test_checkpoint_roundtrips_adam_slots_and_count(tmp_path):
+    """Adam carries two moment trees plus an integer step count; the
+    count's dtype (int32) must not get promoted to float on the trip."""
+    import numpy as np
+
+    from kubeshare_tpu.models import tinymlp
+
+    params = tinymlp.init(jax.random.PRNGKey(0))
+    optimizer = optax.adam(1e-3)
+    state = optimizer.init(params)
+    step = make_train_step(tinymlp.loss_fn, optimizer)
+    for i in range(4):
+        params, state, _ = step(params, state,
+                                tinymlp.batch_fn(jax.random.PRNGKey(i)))
+    _, s = _roundtrip(tmp_path, params, state)
+    counts = [x for x in jax.tree_util.tree_leaves(s)
+              if jnp.issubdtype(x.dtype, jnp.integer)]
+    assert counts and all(np.asarray(c) == 4 for c in counts)
+
+
+def test_checkpoint_roundtrips_mixed_dtypes_and_empty_leaves(tmp_path):
+    """Hand-built state tree with the awkward leaves real optimizer
+    stacks produce: bfloat16 moments, int32 counts, float32 params and
+    a zero-length leaf (an empty optax partition)."""
+    params = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+              "h": jnp.ones((2, 2), jnp.bfloat16)}
+    opt_state = {"mu": {"w": jnp.full((3, 4), 0.5, jnp.bfloat16),
+                        "h": jnp.zeros((2, 2), jnp.bfloat16)},
+                 "count": jnp.asarray(7, jnp.int32),
+                 "empty": jnp.zeros((0, 4), jnp.float32)}
+    p, s = _roundtrip(tmp_path, params, opt_state, step=7)
+    assert s["empty"].shape == (0, 4)
+    assert s["mu"]["w"].dtype == jnp.bfloat16
+    assert s["count"].dtype == jnp.int32
+
+
 def test_cli_resume_skips_done_steps(tmp_path):
     """`--checkpoint` on the model CLI: a rerun with the same args resumes
     and only runs the remaining steps."""
