@@ -112,7 +112,12 @@ class ElasticOrchestrator:
 
     def register_restater(self, gang: str, fn) -> None:
         """``fn(plan)`` runs between pause and flip; raising aborts the
-        resize back to the old mesh."""
+        resize back to the old mesh. If the flip itself then fails,
+        ``fn`` is invoked once more with the mirrored plan
+        (``revert: True``, ``to_chips`` = the original chips) so the
+        data plane follows the control plane back — restaters must
+        therefore be revertible: a second call with the old chip set
+        restores the old layout."""
         self._restaters[gang] = fn
 
     def unregister_restater(self, gang: str) -> None:
@@ -133,6 +138,23 @@ class ElasticOrchestrator:
             log.warning("elastic journal write failed: %s", e)
 
     # -- planning --------------------------------------------------------
+
+    @staticmethod
+    def _revert_plan(plan: dict) -> dict:
+        """The mirror of *plan*: re-homes the data plane back onto the
+        original chip set after a failed flip. ``revert: True`` lets a
+        restater tell an un-restate from a fresh resize."""
+        return {"gang": plan["gang"],
+                "from_chips": plan["to_chips"],
+                "to_chips": plan["from_chips"],
+                "direction": ("shrink" if plan["direction"] == "grow"
+                              else "grow"),
+                "revert": True,
+                "moves": [{"pod": mv["pod"],
+                           "from_chip": mv["to_chip"],
+                           "to_chip": mv["from_chip"],
+                           "request": mv["request"]}
+                          for mv in reversed(plan["moves"])]}
 
     @staticmethod
     def _dest_memory(req: float, mem: int, src, dst) -> int:
@@ -179,18 +201,30 @@ class ElasticOrchestrator:
             keepset = set(keep)
             free = {c: (eng.leaf_cells[c].available
                         if c in eng.leaf_cells else 0.0) for c in keep}
+            freemem = {c: (eng.leaf_cells[c].free_memory
+                           if c in eng.leaf_cells else 0) for c in keep}
             movers = [p for c in cur if c not in keepset
                       for p in by_chip[c]]
             movers.sort(key=lambda p: (-p.bookings[0][1], p.key))
             for p in movers:
-                req = p.bookings[0][1]
+                _, req, mem = p.bookings[0]
+                src = eng.leaf_cells.get(p.bookings[0][0])
+
+                def need(c):
+                    dst = eng.leaf_cells.get(c)
+                    if src is None or dst is None:
+                        return mem
+                    return self._dest_memory(req, mem, src, dst)
+
                 dest = next(
                     (c for c in sorted(keep,
                                        key=lambda c: (-free[c], c))
-                     if free[c] + 1e-9 >= req), None)
+                     if free[c] + 1e-9 >= req
+                     and freemem[c] >= need(c)), None)
                 if dest is None:
                     return None, "no-capacity"
                 free[dest] -= req
+                freemem[dest] -= need(dest)
                 moves.append({"pod": p.key,
                               "from_chip": p.bookings[0][0],
                               "to_chip": dest, "request": req})
@@ -289,9 +323,17 @@ class ElasticOrchestrator:
                 back = eng.leaf_cells.get(old_booking[0])
                 if back is not None:
                     reserve_resource(back, old_booking[1], old_booking[2])
-                if new_port and pod.node_name in eng.ports:
-                    eng.ports[pod.node_name].unmask(
-                        new_port - C.POD_MANAGER_PORT_START)
+                if new_port:
+                    if pod.node_name in eng.ports:
+                        eng.ports[pod.node_name].unmask(
+                            new_port - C.POD_MANAGER_PORT_START)
+                    # the forward path freed the old node's slot when
+                    # it claimed the new one — take it back, or the
+                    # restored pod.port aliases a free slot the engine
+                    # can hand to another pod
+                    if old_port and old_node in eng.ports:
+                        eng.ports[old_node].mask(
+                            old_port - C.POD_MANAGER_PORT_START)
                 pod.bookings[0] = old_booking
                 pod.cells = old_cells
                 pod.chip_ids = old_chips
@@ -325,11 +367,14 @@ class ElasticOrchestrator:
                 if dst.node != pod.node_name and pod.port:
                     # the manager port is node-local: release the old
                     # node's slot, claim one on the destination
-                    offset = eng.ports[dst.node].find_next_and_set()
+                    pool = eng.ports.get(dst.node)
+                    offset = -1 if pool is None \
+                        else pool.find_next_and_set()
                     if offset < 0:
                         raise _FlipError(
                             f"{mv['pod']}: node {dst.node} port pool "
-                            "exhausted")
+                            + ("missing" if pool is None
+                               else "exhausted"))
                     new_port = C.POD_MANAGER_PORT_START + offset
                 reclaim_resource(src, req, mem)
                 reserve_resource(dst, req, new_mem)
@@ -343,23 +388,29 @@ class ElasticOrchestrator:
                     pod.port = new_port
                 pod.node_name = dst.node
                 applied.append(old[:7] + (new_port,))
-        except _FlipError:
+            members = self._members_locked(eng, plan["gang"])
+            if members:
+                # the gang's placement plan (if any survived this
+                # long) described the old chips — drop it, the
+                # evict-path way
+                group = eng.group_of(members[0])
+                group.plan = None
+                group.plan_taken = {}
+                group.plan_stale_gen = -1
+                eng.alloc_gen += 1
+                d._sync_gang(members[0])
+                self._republish(d, [mv["pod"] for mv in plan["moves"]])
+            chips = sorted({p.bookings[0][0] for p in members})
+            coords = [getattr(eng.leaf_cells.get(c), "coords", ()) or ()
+                      for c in chips]
+        except Exception:
+            # not just _FlipError: ANY failure mid-flip (a raced map, a
+            # sync error after bookings moved) must restore the old
+            # placement before it propagates — the caller only decides
+            # how to report, never how to untear
             _rollback()
+            d._cond.notify_all()
             raise
-        members = self._members_locked(eng, plan["gang"])
-        if members:
-            # the gang's placement plan (if any survived this long)
-            # described the old chips — drop it, the evict-path way
-            group = eng.group_of(members[0])
-            group.plan = None
-            group.plan_taken = {}
-            group.plan_stale_gen = -1
-            eng.alloc_gen += 1
-            d._sync_gang(members[0])
-            self._republish(d, [mv["pod"] for mv in plan["moves"]])
-        chips = sorted({p.bookings[0][0] for p in members})
-        coords = [getattr(eng.leaf_cells.get(c), "coords", ()) or ()
-                  for c in chips]
         d._cond.notify_all()
         return carve_env(chips, coords)
 
@@ -449,53 +500,91 @@ class ElasticOrchestrator:
             self._finish(out, now, direction)
             return out
         self._journal({"event": "pause", "gang": gang, "seq": seq})
-        restate = self._restaters.get(gang)
-        if restate is not None:
-            try:
-                restate(dict(plan))
-            except Exception as e:
+        resumed = False
+
+        def _resume():
+            # once-guard: every exit below resumes exactly one time,
+            # and the finally backstop means no exception path —
+            # however unexpected — can strand the gang drain-paused
+            nonlocal resumed
+            if not resumed:
+                resumed = True
                 if self.gangcoord is not None:
                     self.gangcoord.resume(gang)
+
+        restate = self._restaters.get(gang)
+        restated = False
+        try:
+            if restate is not None:
+                try:
+                    restate(dict(plan))
+                except Exception as e:
+                    _resume()
+                    self._journal({"event": "abort", "gang": gang,
+                                   "seq": seq, "step": "restate",
+                                   "reason": str(e)})
+                    out = dict(base, outcome="rolled_back",
+                               reason=f"restate: {e}")
+                    self._finish(out, now, direction)
+                    return out
+                restated = True
+            self._journal({"event": "restate", "gang": gang,
+                           "seq": seq})
+            try:
+                with d.lock:
+                    layout = self._flip_locked(d, plan)
+            except Exception as e:
+                # _flip_locked restored the bookings before raising —
+                # for ANY exception, not just _FlipError — so here we
+                # only un-tear the data plane and report
+                why = str(e) or type(e).__name__
+                if restated:
+                    # the trainer already re-sharded onto the target
+                    # devices: run the mirrored plan so the resumed
+                    # job computes on the chips it actually holds
+                    try:
+                        restate(self._revert_plan(plan))
+                        self._journal({"event": "unrestate",
+                                       "gang": gang, "seq": seq})
+                    except Exception as ue:
+                        log.error(
+                            "elastic: un-restate of %s failed (%s); "
+                            "data plane may disagree with the old "
+                            "placement until the next restate", gang,
+                            ue)
+                        self._journal({"event": "unrestate-failed",
+                                       "gang": gang, "seq": seq,
+                                       "reason": str(ue)})
+                        why += f"; un-restate failed: {ue}"
+                _resume()
                 self._journal({"event": "abort", "gang": gang,
-                               "seq": seq, "step": "restate",
-                               "reason": str(e)})
-                out = dict(base, outcome="rolled_back",
-                           reason=f"restate: {e}")
+                               "seq": seq, "step": "flip",
+                               "reason": why})
+                out = dict(base, outcome="rolled_back", reason=why)
                 self._finish(out, now, direction)
                 return out
-        self._journal({"event": "restate", "gang": gang, "seq": seq})
-        try:
-            with d.lock:
-                layout = self._flip_locked(d, plan)
-        except _FlipError as e:
-            if self.gangcoord is not None:
-                self.gangcoord.resume(gang)
-            self._journal({"event": "abort", "gang": gang, "seq": seq,
-                           "step": "flip", "reason": str(e)})
-            out = dict(base, outcome="rolled_back", reason=str(e))
+            # COMMIT POINT: after this record recovery lands on the
+            # new mesh; before it, on the old one
+            self._journal({"event": "flip", "gang": gang, "seq": seq,
+                           "layout": layout,
+                           "chips": plan["to_chips"]})
+            _resume()
+            pause_s = self._clock() - t0
+            self._journal({"event": "resume", "gang": gang,
+                           "seq": seq, "pause_s": round(pause_s, 6)})
+            self._pause_waits.setdefault(
+                gang, deque(maxlen=256)).append(pause_s)
+            _PAUSE.observe(value=pause_s)
+            _MOVES.inc(amount=float(len(plan["moves"])))
+            _CHIPS.set(gang, value=float(len(plan["to_chips"])))
+            for mv in plan["moves"]:
+                self.cooldowns.note(mv["pod"], now)
+            out = dict(base, outcome="applied", layout=layout,
+                       pause_s=round(pause_s, 6))
             self._finish(out, now, direction)
             return out
-        # COMMIT POINT: after this record recovery lands on the new
-        # mesh; before it, on the old one
-        self._journal({"event": "flip", "gang": gang, "seq": seq,
-                       "layout": layout,
-                       "chips": plan["to_chips"]})
-        if self.gangcoord is not None:
-            self.gangcoord.resume(gang)
-        pause_s = self._clock() - t0
-        self._journal({"event": "resume", "gang": gang, "seq": seq,
-                       "pause_s": round(pause_s, 6)})
-        self._pause_waits.setdefault(
-            gang, deque(maxlen=256)).append(pause_s)
-        _PAUSE.observe(value=pause_s)
-        _MOVES.inc(amount=float(len(plan["moves"])))
-        _CHIPS.set(gang, value=float(len(plan["to_chips"])))
-        for mv in plan["moves"]:
-            self.cooldowns.note(mv["pod"], now)
-        out = dict(base, outcome="applied", layout=layout,
-                   pause_s=round(pause_s, 6))
-        self._finish(out, now, direction)
-        return out
+        finally:
+            _resume()
 
     # -- introspection ---------------------------------------------------
 

@@ -263,6 +263,165 @@ def test_flip_conflict_rolls_back_whole_gang(tmp_path):
     assert recover(journal)[gang]["mesh"] == "old"
 
 
+def test_flip_rollback_restores_port_slots_on_both_nodes():
+    """A cross-node move flips the pod-manager port (release the old
+    node's slot, claim one on the destination). When a LATER move then
+    fails and the whole gang rolls back, both halves must unwind: the
+    destination's claim released AND the old node's slot re-masked —
+    a leak there lets the engine hand the same port to another pod."""
+    clk = FakeClock()
+    disp = make_disp(hosts=4, mesh=(1, 1), clock=clk)
+    gang = bind_gang(disp)          # 4 members @0.5 -> 2 one-chip hosts
+    orch = make_orch(disp, clk)
+    eng = disp.engine
+    with disp.lock:
+        before = {p.key: (p.node_name, p.port)
+                  for p in eng.pod_status.values()
+                  if p.group_key == gang}
+        counts = {n: bm.count() for n, bm in eng.ports.items()}
+    assert all(port for _, port in before.values())
+
+    def steal_last(plan):
+        # fail only the LAST move, so the earlier cross-node move (and
+        # its port flip) is applied first and must be rolled back
+        with disp.lock:
+            cell = eng.leaf_cells[plan["moves"][-1]["to_chip"]]
+            reserve_resource(cell, cell.available, 0)
+
+    orch.register_restater(gang, steal_last)
+    out = orch.resize(gang, 4, now=clk.t)
+    assert out["outcome"] == "rolled_back"
+    with disp.lock:
+        # the aborted plan really crossed nodes (the port-flip path)
+        assert [mv for mv in out["moves"]
+                if eng.leaf_cells[mv["to_chip"]].node
+                != before[mv["pod"]][0]]
+        for p in eng.pod_status.values():
+            if p.group_key != gang:
+                continue
+            node, port = before[p.key]
+            assert (p.node_name, p.port) == (node, port)
+            # the advertised port is still CLAIMED on its node's bitmap
+            assert eng.ports[node].is_masked(
+                port - C.POD_MANAGER_PORT_START)
+        assert {n: bm.count() for n, bm in eng.ports.items()} == counts
+
+
+def test_flip_failure_unrestates_the_trainer(tmp_path):
+    """Restate succeeded (the trainer re-sharded onto the target
+    devices) but the flip then failed: the orchestrator must run the
+    mirrored revert plan so the resumed job computes on the chips it
+    actually holds — not a torn control/data-plane hybrid."""
+    import optax
+
+    from kubeshare_tpu.elastic import ElasticTrainer
+    from kubeshare_tpu.models import tinymlp
+
+    clk = FakeClock()
+    disp = make_disp(clock=clk)
+    gc = GangTokenCoordinator(clock=clk)
+    disp.attach_gang_coordinator(gc)
+    gang = bind_gang(disp)
+    journal = str(tmp_path / "elastic.jsonl")
+    orch = make_orch(disp, clk, gangcoord=gc, journal=journal)
+    devs = jax.devices()
+    tr = ElasticTrainer(tinymlp.loss_fn, optax.sgd(0.05),
+                        tinymlp.init(jax.random.PRNGKey(0)),
+                        devices=devs[:2])
+    inner = tr.restater(lambda n: devs[:n])
+    plans: list = []
+
+    def restate_then_steal(plan):
+        plans.append(plan)
+        inner(plan)
+        if not plan.get("revert"):
+            with disp.lock:
+                for mv in plan["moves"]:
+                    cell = disp.engine.leaf_cells[mv["to_chip"]]
+                    reserve_resource(cell, cell.available, 0)
+
+    orch.register_restater(gang, restate_then_steal)
+    before = gang_chips(disp, gang)
+    out = orch.resize(gang, 4, now=clk.t)
+    assert out["outcome"] == "rolled_back"
+    # the trainer followed the control plane back to the old mesh
+    assert tr.num_devices == 2
+    assert [r["chips"] for r in tr.resizes] == [4, 2]
+    assert [p.get("revert", False) for p in plans] == [False, True]
+    assert plans[1]["to_chips"] == plans[0]["from_chips"]
+    assert plans[1]["moves"][0]["from_chip"] == \
+        plans[0]["moves"][-1]["to_chip"]
+    assert gang_chips(disp, gang) == before
+    events = [json.loads(ln)["event"]
+              for ln in open(journal).read().splitlines()]
+    assert events == ["plan", "pause", "restate", "unrestate", "abort"]
+    assert recover(journal)[gang]["mesh"] == "old"
+    st = {s["gang"]: s for s in gc.grant_states(clk.t)}
+    assert gang not in st or not st[gang]["paused"]
+
+
+def test_unexpected_flip_exception_rolls_back_and_resumes(tmp_path):
+    """Non-_FlipError failures inside the flip (here: a sync error
+    AFTER every booking moved) must behave exactly like a verification
+    conflict: whole-gang rollback, journal abort, gang resumed — never
+    an exception escaping with the engine torn and the gang paused."""
+    clk = FakeClock()
+    disp = make_disp(clock=clk)
+    gc = GangTokenCoordinator(clock=clk)
+    disp.attach_gang_coordinator(gc)
+    gang = bind_gang(disp)
+    journal = str(tmp_path / "elastic.jsonl")
+    orch = make_orch(disp, clk, gangcoord=gc, journal=journal)
+    before = gang_chips(disp, gang)
+    with disp.lock:
+        bookings = {p.key: p.bookings[0]
+                    for p in disp.engine.pod_status.values()
+                    if p.group_key == gang}
+
+    def boom(_pod):
+        raise RuntimeError("sync exploded")
+
+    disp._sync_gang = boom
+    out = orch.resize(gang, 4, now=clk.t)
+    assert out["outcome"] == "rolled_back"
+    assert "sync exploded" in out["reason"]
+    assert gang_chips(disp, gang) == before
+    with disp.lock:
+        for p in disp.engine.pod_status.values():
+            if p.group_key == gang:
+                assert p.bookings[0] == bookings[p.key]
+    st = {s["gang"]: s for s in gc.grant_states(clk.t)}
+    assert gang not in st or not st[gang]["paused"]
+    assert recover(journal)[gang]["mesh"] == "old"
+
+
+def test_shrink_packing_respects_memory_headroom():
+    """First-fit packing must skip a keep chip whose compute fits but
+    whose HBM headroom does not — refusing the whole resize when a
+    memory-feasible packing exists is a spurious 'no-capacity'."""
+    clk = FakeClock()
+    disp = make_disp(clock=clk)
+    gang = bind_gang(disp)
+    orch = make_orch(disp, clk)
+    out = orch.resize(gang, 4, now=clk.t)
+    assert out["outcome"] == "applied"   # 1 member @0.5 on each chip
+
+    # drain the HBM of the keep chip first-fit would choose (all keeps
+    # tie on free compute, so the lexicographically-first wins)
+    chips = gang_chips(disp, gang)
+    with disp.lock:
+        cells = disp.engine.leaf_cells
+        keep = sorted(chips)[:3]
+        full_cell = cells[keep[0]]
+        reserve_resource(full_cell, 0.0, full_cell.free_memory)
+    out = orch.resize(gang, 3, now=clk.t)
+    assert out["outcome"] == "applied"
+    assert len(out["moves"]) == 1
+    dest = out["moves"][0]["to_chip"]
+    assert dest in keep and dest != full_cell.chip_id
+    assert len(gang_chips(disp, gang)) == 3
+
+
 def test_journal_recovery_new_old_and_torn(tmp_path):
     clk = FakeClock()
     disp = make_disp(clock=clk)
